@@ -1,0 +1,12 @@
+from distributed_tensorflow_guide_tpu.collectives.collectives import (  # noqa: F401
+    CommTrace,
+    all_gather,
+    all_to_all,
+    axis_size,
+    pmean,
+    ppermute,
+    psum,
+    reduce_scatter,
+    ring_shift,
+    trace_comm,
+)

@@ -1,0 +1,142 @@
+"""The communication layer — NCCL/gRPC equivalent, in one traceable place.
+
+Reference equivalents:
+  * async PS traffic: implicit gRPC send/recv inserted by the TF graph
+    partitioner between /job:worker and /job:ps
+    (tensorflow/python/training/server_lib.py:96; placement via
+    tensorflow/python/training/device_setter.py:129) — the guide never calls a
+    collective explicitly.
+  * sync traffic (modern surface): ``NcclAllReduce`` / ``CollectiveAllReduce``
+    (tensorflow/python/distribute/cross_device_ops.py:961,:1045) selected via
+    ``CommunicationImplementation.NCCL``
+    (tensorflow/python/distribute/collective_util.py).
+
+Here communication is *explicit and named*: every collective the framework
+issues goes through these wrappers, so a single ``trace_comm()`` context can
+count ops and bytes for any jitted program (the observability the reference
+lacks entirely). All functions must be called under ``shard_map`` (or a
+``pjit`` body with manual axes) where ``axis`` is a mesh axis name.
+
+On hardware these lower to XLA ICI collectives: psum → all-reduce ring,
+all_gather → bidirectional ring gather, ppermute → neighbor ICI hop,
+all_to_all → ICI transpose. Over multi-slice deployments XLA routes the DCN
+legs automatically from the mesh's device assignment.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+from collections import defaultdict
+from typing import Any
+
+import jax
+from jax import lax
+
+_TRACE: contextvars.ContextVar["CommTrace | None"] = contextvars.ContextVar(
+    "dtg_comm_trace", default=None
+)
+
+
+@dataclasses.dataclass
+class CommTrace:
+    """Counts collective *call sites* (per trace) and traced payload bytes."""
+
+    calls: dict[str, int] = dataclasses.field(
+        default_factory=lambda: defaultdict(int)
+    )
+    bytes: dict[str, int] = dataclasses.field(
+        default_factory=lambda: defaultdict(int)
+    )
+
+    def record(self, op: str, axis: Any, tree: Any) -> None:
+        key = f"{op}[{axis}]"
+        self.calls[key] += 1
+        n = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+                n += int(leaf.size) * leaf.dtype.itemsize
+        self.bytes[key] += n
+
+    def total_calls(self) -> int:
+        return sum(self.calls.values())
+
+
+@contextlib.contextmanager
+def trace_comm():
+    """Record all collectives issued while tracing code under this context.
+
+    Counts are per-trace (graph-level), like counting NCCL launch sites —
+    re-executing a compiled function does not re-count.
+    """
+    rec = CommTrace()
+    token = _TRACE.set(rec)
+    try:
+        yield rec
+    finally:
+        _TRACE.reset(token)
+
+
+def _record(op: str, axis: Any, tree: Any) -> None:
+    rec = _TRACE.get()
+    if rec is not None:
+        rec.record(op, axis, tree)
+
+
+def axis_size(axis: str) -> int:
+    """Size of a mesh axis from inside shard_map (NCCL world-size analogue)."""
+    return lax.axis_size(axis)
+
+
+def psum(x, axis: str):
+    """All-reduce sum — replaces NcclAllReduce
+    (tensorflow/python/distribute/cross_device_ops.py:961) and the reference's
+    SyncReplicasOptimizer accumulator+token-queue barrier
+    (tensorflow/python/training/sync_replicas_optimizer.py:42)."""
+    _record("psum", axis, x)
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: str):
+    """All-reduce mean — gradient averaging across the data axis."""
+    _record("pmean", axis, x)
+    return lax.pmean(x, axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = False, gather_axis: int = 0):
+    """All-gather — replaces NCCL allgather per the north-star mapping."""
+    _record("all_gather", axis, x)
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_axis: int = 0):
+    """Reduce-scatter (psum_scatter) — the memory-optimal half of an
+    all-reduce; used for ZeRO/FSDP-style sharded gradient reduction."""
+    _record("reduce_scatter", axis, x)
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ppermute(x, axis: str, perm):
+    """Point-to-point permutation over the mesh axis — the ICI-neighbor hop
+    used by pipeline stages and ring attention."""
+    _record("ppermute", axis, x)
+    return lax.ppermute(x, axis, perm)
+
+
+def ring_shift(x, axis: str, *, shift: int = 1):
+    """Rotate values one (or `shift`) steps around the axis ring.
+
+    Device i sends to device (i+shift) mod n — the KV-rotation primitive of
+    ring attention and the activation hand-off of pipeline parallelism.
+    """
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return ppermute(x, axis, perm)
+
+
+def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int):
+    """All-to-all resharding — the Ulysses sequence↔heads exchange and the
+    MoE token-routing primitive."""
+    _record("all_to_all", axis, x)
+    return lax.all_to_all(x, axis, split_axis, concat_axis, tiled=True)

@@ -1,0 +1,2 @@
+from distributed_tensorflow_guide_tpu.core.mesh import AXES, MeshSpec, build_mesh  # noqa: F401
+from distributed_tensorflow_guide_tpu.core.dist import initialize  # noqa: F401

@@ -1,0 +1,119 @@
+"""Multi-host bootstrap — replaces the reference's cluster-bootstrap stack.
+
+Reference call stack (SURVEY.md §3.1): ``bash run.sh`` spawns N+1 processes,
+each builds ``tf.train.ClusterSpec`` and starts an in-process gRPC
+``tf.train.Server`` (tensorflow/python/training/server_lib.py:96); PS
+processes block in ``server.join()`` forever; the modern surface discovers
+peers from the ``TF_CONFIG`` env JSON
+(tensorflow/python/distribute/cluster_resolver/tfconfig_cluster_resolver.py:48).
+
+TPU-native: that entire stack collapses to ``jax.distributed.initialize()``
+per host (jax/_src/distributed.py) — a coordinator handshake over DCN after
+which every host sees the global device set and runs the *same* SPMD program.
+There is no PS process and no role flag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class DistConfig:
+    """Multi-host coordination config.
+
+    All fields optional: on TPU pods JAX auto-detects everything from the
+    metadata server; on CPU/GPU clusters pass them explicitly or set
+    JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID (the latter
+    two are parsed by this framework via :meth:`from_env` and forwarded as
+    kwargs — JAX itself only reads the coordinator address).
+    """
+
+    coordinator_address: str | None = None
+    num_processes: int | None = None
+    process_id: int | None = None
+
+    @classmethod
+    def from_env(cls) -> "DistConfig":
+        """Read JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID.
+
+        JAX itself only reads JAX_COORDINATOR_ADDRESS; the other two are
+        this framework's convention and are parsed here and passed through
+        as explicit kwargs.
+        """
+        nproc = os.environ.get("JAX_NUM_PROCESSES")
+        pid = os.environ.get("JAX_PROCESS_ID")
+        return cls(
+            coordinator_address=os.environ.get("JAX_COORDINATOR_ADDRESS"),
+            num_processes=int(nproc) if nproc is not None else None,
+            process_id=int(pid) if pid is not None else None,
+        )
+
+
+_initialized = False
+
+
+def initialize(config: DistConfig | None = None) -> None:
+    """Idempotent multi-host init. No-op for single-process runs.
+
+    Single-process is detected when no coordinator is configured anywhere —
+    the common case for tests and single-host benches.
+    """
+    global _initialized
+    if _initialized:
+        return
+    # An explicitly passed config wins wholesale — env vars are only read
+    # when no config is given (so stale JAX_* exports can't leak into an
+    # explicit setup, and an explicit all-None config can't be promoted to a
+    # multi-host handshake by the environment).
+    explicit = config is not None
+    config = config if explicit else DistConfig.from_env()
+    coord, nproc, pid = (
+        config.coordinator_address,
+        config.num_processes,
+        config.process_id,
+    )
+    # num_processes == 1 with no coordinator means "force single-process".
+    # TPU_WORKER_HOSTNAMES with a single entry (e.g. "localhost" on a
+    # single-host slice) is also a single-process run.
+    multi_host_tpu = (not explicit) and "," in os.environ.get(
+        "TPU_WORKER_HOSTNAMES", ""
+    )
+    if (coord is None and nproc is None and not multi_host_tpu) or (
+        coord is None and nproc == 1
+    ):
+        log.debug("single-process run; skipping jax.distributed.initialize")
+        return
+    kwargs = {}
+    if coord is not None:
+        kwargs["coordinator_address"] = coord
+    if nproc is not None:
+        kwargs["num_processes"] = nproc
+    if pid is not None:
+        kwargs["process_id"] = pid
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    log.info(
+        "distributed init: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def is_chief() -> bool:
+    """Process 0 — the one that writes checkpoints/logs.
+
+    Reference equivalent: ``is_chief=(task_index == 0)`` passed to
+    ``MonitoredTrainingSession`` (tensorflow/python/training/monitored_session.py:428).
+    Unlike the reference, chief-ness here affects only host-side IO; the
+    device program is identical on every host.
+    """
+    return jax.process_index() == 0

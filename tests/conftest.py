@@ -1,0 +1,46 @@
+"""Test harness: 8 virtual CPU devices in one process.
+
+This is the JAX analogue of the TF in-process fake cluster
+(tensorflow/python/framework/test_util.py create_local_cluster /
+tensorflow/python/distribute/multi_worker_test_base.py
+create_in_process_cluster): real collective semantics, no real fabric.
+Env must be set before jax initializes its backends, hence module top-level.
+"""
+
+import os
+
+# Force CPU regardless of the ambient JAX_PLATFORMS (the machine exports
+# JAX_PLATFORMS=axon for the real chip; tests always run on fake devices).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The axon PJRT plugin re-asserts its platform during `import jax`, so the
+# config must be pinned post-import as well.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8():
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(data=-1))
+
+
+@pytest.fixture()
+def rng():
+    return jax.random.PRNGKey(0)

@@ -1,0 +1,58 @@
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_guide_tpu.core.mesh import (
+    AXES,
+    MeshSpec,
+    axis_sizes,
+    build_mesh,
+    single_device_mesh,
+)
+
+
+def test_default_mesh_uses_all_devices():
+    mesh = build_mesh()
+    assert mesh.axis_names == AXES
+    assert mesh.devices.size == len(jax.devices())
+    assert axis_sizes(mesh)["data"] == 8
+
+
+def test_resolve_fill():
+    assert MeshSpec(data=-1, model=2).resolve(8) == {
+        "data": 4,
+        "model": 2,
+        "pipe": 1,
+        "context": 1,
+    }
+
+
+def test_resolve_exact():
+    sizes = MeshSpec(data=2, model=2, pipe=2, context=1).resolve(8)
+    assert sizes == {"data": 2, "model": 2, "pipe": 2, "context": 1}
+
+
+def test_resolve_rejects_bad_product():
+    with pytest.raises(ValueError):
+        MeshSpec(data=3, model=1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, model=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, model=-1).resolve(8)
+
+
+def test_4d_mesh_shape():
+    mesh = build_mesh(MeshSpec(data=2, model=2, pipe=2, context=1))
+    assert mesh.devices.shape == (2, 2, 2, 1)
+
+
+def test_single_device_mesh():
+    mesh = single_device_mesh()
+    assert mesh.devices.shape == (1, 1, 1, 1)
+    assert mesh.axis_names == AXES
+
+
+def test_mesh_subset_of_devices():
+    mesh = build_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
+    assert mesh.devices.size == 4
+    assert np.all(mesh.devices.ravel() == np.asarray(jax.devices()[:4]))
