@@ -1,0 +1,68 @@
+"""Deterministic synthetic datasets — the offline stand-in for MNIST/ImageNet.
+
+The reference downloads MNIST via ``tensorflow.examples.tutorials.mnist``;
+this environment has no network, so every dataset here is generated: each
+class gets a fixed random prototype and samples are prototype + Gaussian
+noise. The task is genuinely learnable (so "loss goes down" means the same
+thing it means in the guide) and fully deterministic given the seed — which
+the determinism checker (utils/determinism.py) relies on.
+
+Batches are host numpy arrays; strategies place them onto the mesh
+(``DataParallel.shard_batch``). Layouts are TPU-native: NHWC images.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+
+class SyntheticClassification:
+    """Infinite iterator of {image, label} batches."""
+
+    def __init__(
+        self,
+        batch_size: int,
+        *,
+        image_shape: tuple[int, ...] = (28, 28, 1),
+        num_classes: int = 10,
+        noise: float = 0.3,
+        seed: int = 0,
+        dtype=np.float32,
+    ):
+        self.batch_size = batch_size
+        self.image_shape = image_shape
+        self.num_classes = num_classes
+        self.noise = noise
+        self.dtype = dtype
+        proto_rng = np.random.RandomState(seed)
+        self.prototypes = proto_rng.randn(num_classes, *image_shape).astype(dtype)
+        self._rng = np.random.RandomState(seed + 1)
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            labels = self._rng.randint(0, self.num_classes, self.batch_size)
+            images = self.prototypes[labels] + self.noise * self._rng.randn(
+                self.batch_size, *self.image_shape
+            ).astype(self.dtype)
+            yield {"image": images.astype(self.dtype), "label": labels.astype(np.int32)}
+
+    def take(self, n: int) -> list[dict]:
+        it = iter(self)
+        return [next(it) for _ in range(n)]
+
+
+def synthetic_mnist(batch_size: int, seed: int = 0) -> SyntheticClassification:
+    return SyntheticClassification(batch_size, seed=seed)
+
+
+def synthetic_imagenet(
+    batch_size: int, image_size: int = 224, seed: int = 0
+) -> SyntheticClassification:
+    return SyntheticClassification(
+        batch_size,
+        image_shape=(image_size, image_size, 3),
+        num_classes=1000,
+        seed=seed,
+    )

@@ -1,0 +1,62 @@
+"""MNIST CNN — the guide's toy model, re-expressed in Flax.
+
+Reference: the small convnet/softmax models used by every example
+(⚠ Non-Distributed-Setup/, Hogwild/, Synchronous-SGD/ in the reference tree;
+behavior = GradientDescentOptimizer training,
+tensorflow/python/training/gradient_descent.py:27). Judged config 1:
+"MNIST CNN under tf.distribute.MirroredStrategy (single host)".
+
+TPU notes: NHWC layout (XLA:TPU native), channel counts padded to
+MXU/VPU-friendly multiples, bf16-ready via the ``dtype`` attribute while
+params stay f32.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MNISTCNN(nn.Module):
+    """Conv(32) → Conv(64) → Dense(128) → Dense(10), ReLU + avg-pool."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:  # x: (B, 28, 28, 1)
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.argmax(logits, axis=-1) == labels)
+
+
+def make_loss_fn(model: MNISTCNN):
+    """``(params, batch) -> (loss, metrics)`` for the DP strategy."""
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["image"])
+        loss = cross_entropy_loss(logits, batch["label"])
+        return loss, {"accuracy": accuracy(logits, batch["label"])}
+
+    return loss_fn

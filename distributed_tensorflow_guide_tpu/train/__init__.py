@@ -1,0 +1,13 @@
+from distributed_tensorflow_guide_tpu.train.hooks import (  # noqa: F401
+    BaseHook,
+    Hook,
+    LoggingHook,
+    MetricsJSONLHook,
+    StepCounterHook,
+    StopAtStepHook,
+)
+from distributed_tensorflow_guide_tpu.train.loop import TrainLoop  # noqa: F401
+from distributed_tensorflow_guide_tpu.train.checkpoint import (  # noqa: F401
+    Checkpointer,
+    CheckpointHook,
+)

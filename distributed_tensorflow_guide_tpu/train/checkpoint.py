@@ -1,0 +1,94 @@
+"""Checkpoint/resume — CheckpointSaverHook equivalent, via Orbax.
+
+Reference: chief-only ``CheckpointSaverHook``
+(tensorflow/python/training/basic_session_run_hooks.py:524) inside
+MonitoredTrainingSession; non-chief workers wait for the chief to initialize
+variables from the checkpoint.
+
+TPU-native: Orbax checkpoints are sharding-aware and multi-host-coordinated —
+every process participates in saving its local shards (no chief bottleneck,
+no PS round-trip), and restore lays shards back onto the live mesh. Resume is
+restore + the step counter, exactly the reference's recovery model (SURVEY.md
+§5 checkpoint row).
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from distributed_tensorflow_guide_tpu.train.hooks import BaseHook
+
+log = logging.getLogger("dtg.train")
+
+
+class Checkpointer:
+    """Thin wrapper over ocp.CheckpointManager for train states."""
+
+    def __init__(self, directory: str | Path, max_to_keep: int = 3):
+        self.directory = Path(directory).absolute()
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        if step in self._mngr.all_steps():  # labels are immutable step counts
+            return False
+        saved = self._mngr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+        if saved:
+            log.info("saved checkpoint at step %d -> %s", step, self.directory)
+        return saved
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore(self, state_like: Any, step: int | None = None) -> Any:
+        """Restore into the structure/shardings of ``state_like``.
+
+        ``state_like`` may be a concrete state (its values are discarded) or
+        a tree of jax.ShapeDtypeStruct with shardings attached.
+        """
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
+        return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.close()
+
+
+class CheckpointHook(BaseHook):
+    """Save every N steps + at end (CheckpointSaverHook equivalent)."""
+
+    def __init__(self, checkpointer: Checkpointer, every_steps: int = 1000):
+        self.ckpt = checkpointer
+        self.every_steps = every_steps
+        self._loop = None
+
+    def begin(self, loop) -> None:
+        self._loop = loop
+
+    def after_step(self, step: int, metrics) -> None:
+        # `step` is the just-completed 0-based index; checkpoint labels are
+        # completed-step *counts* so that resuming with
+        # start_step=latest_step() never replays an already-applied update.
+        done = step + 1
+        if done % self.every_steps == 0:
+            self.ckpt.save(done, self._loop.state)
+
+    def end(self, step: int) -> None:
+        self.ckpt.save(step, self._loop.state, force=True)
+        self.ckpt.wait()

@@ -1,0 +1,76 @@
+"""The training loop — MonitoredTrainingSession, TPU-native.
+
+Reference equivalent: ``tf.train.MonitoredTrainingSession``
+(tensorflow/python/training/monitored_session.py:428) driving
+``while not sess.should_stop(): sess.run(train_op)`` with hooks.
+
+Here the loop drives a *compiled SPMD step function* instead of a session:
+``state, metrics = step_fn(state, batch)``. The function is expected to be
+``jax.jit``-ed (the strategy layers in ``parallel/`` produce it); the loop
+itself stays off the hot path — it only touches host-side Python between
+dispatches, and fetches metric values asynchronously (they are jax.Arrays;
+conversion blocks only when a hook actually reads them).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+from distributed_tensorflow_guide_tpu.train.hooks import Hook
+
+log = logging.getLogger("dtg.train")
+
+StepFn = Callable[[Any, Any], tuple[Any, dict]]
+
+
+class TrainLoop:
+    """Drive ``step_fn`` over batches until a hook requests a stop.
+
+    Unlike MonitoredTrainingSession there is no chief/non-chief split in the
+    device program — every process executes the same compiled step; hooks
+    internally no-op on non-chief processes where appropriate.
+    """
+
+    def __init__(
+        self,
+        step_fn: StepFn,
+        state: Any,
+        data: Iterable,
+        hooks: Sequence[Hook] = (),
+        start_step: int = 0,
+    ):
+        self.step_fn = step_fn
+        self.state = state
+        self.data = data
+        self.hooks = list(hooks)
+        self.step = start_step
+        self._stop = False
+
+    def request_stop(self) -> None:
+        """Hook-callable stop signal (``sess.should_stop()`` equivalent)."""
+        self._stop = True
+
+    @property
+    def should_stop(self) -> bool:
+        return self._stop
+
+    def run(self) -> Any:
+        """Run to completion; returns the final state."""
+        for h in self.hooks:
+            h.begin(self)
+        it: Iterator = iter(self.data)
+        try:
+            while not self._stop:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                self.state, metrics = self.step_fn(self.state, batch)
+                for h in self.hooks:
+                    h.after_step(self.step, metrics)
+                self.step += 1
+        finally:
+            for h in self.hooks:
+                h.end(self.step)
+        return self.state

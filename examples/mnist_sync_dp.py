@@ -1,0 +1,110 @@
+"""Judged config 1: MNIST CNN, synchronous data parallelism.
+
+Reference equivalents: ⚠ Synchronous-SGD/ (SyncReplicasOptimizer barrier,
+tensorflow/python/training/sync_replicas_optimizer.py:42) and the
+MirroredStrategy surface (tensorflow/python/distribute/mirrored_strategy.py:200).
+
+The reference needs a bash launcher spawning 1 PS + N worker processes with
+role flags; here the SAME command runs everywhere — on the single local chip,
+on a CPU fake mesh (--fake-devices 8), or on every host of a pod slice:
+
+    python examples/mnist_sync_dp.py --steps 200
+    python examples/mnist_sync_dp.py --steps 200 --fake-devices 8
+"""
+
+import argparse
+import logging
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--global-batch", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--log-every", type=int, default=20)
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--fake-devices", type=int, default=0,
+                    help="force N virtual CPU devices (testing without a pod)")
+    args = ap.parse_args()
+
+    if args.fake_devices:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.fake_devices:
+        # Both the env var (before import) and this update are required: the
+        # axon TPU plugin re-asserts its platform during `import jax`.
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.fake_devices)
+
+    import jax.numpy as jnp
+    import optax
+    from flax.training import train_state
+
+    from distributed_tensorflow_guide_tpu.core.dist import initialize
+    from distributed_tensorflow_guide_tpu.core.mesh import (
+        MeshSpec,
+        axis_sizes,
+        build_mesh,
+    )
+    from distributed_tensorflow_guide_tpu.data.synthetic import synthetic_mnist
+    from distributed_tensorflow_guide_tpu.models.mnist_cnn import MNISTCNN, make_loss_fn
+    from distributed_tensorflow_guide_tpu.parallel.data_parallel import DataParallel
+    from distributed_tensorflow_guide_tpu.train import (
+        CheckpointHook,
+        Checkpointer,
+        LoggingHook,
+        StepCounterHook,
+        StopAtStepHook,
+        TrainLoop,
+    )
+
+    # force=True: absl (pulled in by jax) installs a WARNING-level root
+    # handler on import that would otherwise swallow INFO logs.
+    logging.basicConfig(level=logging.INFO, format="%(message)s", force=True)
+    initialize()
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    n_dev = mesh.devices.size
+    if args.global_batch % n_dev:
+        raise SystemExit(f"--global-batch must divide by {n_dev} devices")
+
+    dp = DataParallel(mesh)
+    model = MNISTCNN()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    state = dp.replicate(
+        train_state.TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.sgd(args.lr, momentum=0.9)
+        )
+    )
+
+    step = dp.make_train_step(make_loss_fn(model))
+    data = (dp.shard_batch(b) for b in synthetic_mnist(args.global_batch))
+
+    hooks = [
+        StopAtStepHook(args.steps),
+        LoggingHook(args.log_every),
+        StepCounterHook(args.log_every, batch_size=args.global_batch, n_chips=n_dev),
+    ]
+    start_step = 0
+    if args.ckpt_dir:
+        ckpt = Checkpointer(args.ckpt_dir)
+        if ckpt.latest_step() is not None:  # resume: restore + step counter
+            start_step = ckpt.latest_step()
+            state = ckpt.restore(state)
+            print(f"resumed from step {start_step}")
+        hooks.append(CheckpointHook(ckpt, every_steps=100))
+
+    loop = TrainLoop(step, state, data, hooks=hooks, start_step=start_step)
+    loop.run()
+    print(f"done: {loop.step} steps on {n_dev} device(s), mesh axes "
+          f"{axis_sizes(mesh)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax.training import train_state
+
+from distributed_tensorflow_guide_tpu.models.mnist_cnn import MNISTCNN
+from distributed_tensorflow_guide_tpu.train import (
+    Checkpointer,
+    CheckpointHook,
+    StopAtStepHook,
+    TrainLoop,
+)
+
+
+def _state():
+    model = MNISTCNN()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    return train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.1)
+    )
+
+
+def test_save_restore_roundtrip(tmp_path):
+    state = _state()
+    ckpt = Checkpointer(tmp_path / "ckpt")
+    ckpt.save(3, state, force=True)
+    ckpt.wait()
+    assert ckpt.latest_step() == 3
+    restored = ckpt.restore(state)
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ckpt.close()
+
+
+def test_restore_missing_raises(tmp_path):
+    ckpt = Checkpointer(tmp_path / "empty")
+    try:
+        import pytest
+
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore(_state())
+    finally:
+        ckpt.close()
+
+
+def test_checkpoint_hook_saves_periodically_and_at_end(tmp_path):
+    ckpt = Checkpointer(tmp_path / "ckpt", max_to_keep=10)
+
+    def step_fn(state, batch):
+        return state.replace(step=state.step + 1), {"loss": jnp.float32(0.0)}
+
+    loop = TrainLoop(
+        step_fn,
+        _state(),
+        iter(lambda: 0, 1),
+        hooks=[StopAtStepHook(5), CheckpointHook(ckpt, every_steps=2)],
+    )
+    final = loop.run()
+    ckpt.wait()
+    assert ckpt.latest_step() == 5  # end-of-run save
+    # labels are completed-step counts and must equal the state's own step,
+    # so resume never replays an applied update
+    for label in (2, 4, 5):
+        restored = ckpt.restore(final, step=label)
+        assert int(restored.step) == label
+    ckpt.close()
+
+
+def test_resumed_finished_run_is_a_noop(tmp_path):
+    state = _state()
+
+    def step_fn(s, batch):
+        return s.replace(step=s.step + 1), {}
+
+    loop = TrainLoop(step_fn, state, iter(lambda: 0, 1),
+                     hooks=[StopAtStepHook(3)], start_step=3)
+    final = loop.run()
+    assert loop.step == 3 and int(final.step) == 0  # no extra update executed
+
+
+def test_resume_continues_from_checkpoint(tmp_path):
+    """The MonitoredTrainingSession recovery model: restore + step counter."""
+    ckpt = Checkpointer(tmp_path / "ckpt")
+    state = _state()
+
+    def step_fn(s, batch):
+        return s.replace(step=s.step + 1), {}
+
+    loop = TrainLoop(step_fn, state, iter(lambda: 0, 1), hooks=[StopAtStepHook(3)])
+    final = loop.run()
+    ckpt.save(int(final.step), final, force=True)
+    ckpt.wait()
+
+    # "crash"; new process restores and continues to 6
+    start = ckpt.latest_step()
+    resumed = ckpt.restore(state)
+    loop2 = TrainLoop(
+        step_fn, resumed, iter(lambda: 0, 1),
+        hooks=[StopAtStepHook(6)], start_step=start,
+    )
+    final2 = loop2.run()
+    assert loop2.step == 6 and int(final2.step) == 6
+    ckpt.close()

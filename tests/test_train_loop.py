@@ -1,0 +1,71 @@
+import json
+
+import jax.numpy as jnp
+
+from distributed_tensorflow_guide_tpu.train import (
+    LoggingHook,
+    MetricsJSONLHook,
+    StepCounterHook,
+    StopAtStepHook,
+    TrainLoop,
+)
+
+
+def _toy_step(state, batch):
+    return state + batch, {"loss": jnp.asarray(1.0 / (state + 1.0))}
+
+
+def _ones():
+    while True:
+        yield 1.0
+
+
+def test_stop_at_step():
+    loop = TrainLoop(_toy_step, 0.0, _ones(), hooks=[StopAtStepHook(5)])
+    final = loop.run()
+    assert loop.step == 5
+    assert final == 5.0
+
+
+def test_data_exhaustion_stops_loop():
+    loop = TrainLoop(_toy_step, 0.0, [1.0, 1.0, 1.0])
+    final = loop.run()
+    assert loop.step == 3 and final == 3.0
+
+
+def test_metrics_jsonl(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    loop = TrainLoop(
+        _toy_step,
+        0.0,
+        _ones(),
+        hooks=[StopAtStepHook(4), MetricsJSONLHook(path, every_steps=2)],
+    )
+    loop.run()
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["step"] for r in recs] == [0, 2]
+    assert abs(recs[1]["loss"] - 1.0 / 3.0) < 1e-6
+
+
+def test_step_counter_measures():
+    h = StepCounterHook(every_steps=2, batch_size=8, n_chips=2)
+    loop = TrainLoop(_toy_step, 0.0, _ones(), hooks=[StopAtStepHook(7), h])
+    loop.run()
+    assert h.last_steps_per_sec is not None and h.last_steps_per_sec > 0
+    assert h.last_examples_per_sec_per_chip == h.last_steps_per_sec * 4
+
+
+def test_logging_hook_runs(caplog):
+    import logging
+
+    with caplog.at_level(logging.INFO, logger="dtg.train"):
+        TrainLoop(
+            _toy_step, 0.0, _ones(), hooks=[StopAtStepHook(3), LoggingHook(1)]
+        ).run()
+    assert any("loss=" in r.message for r in caplog.records)
+
+
+def test_start_step_resume_semantics():
+    loop = TrainLoop(_toy_step, 0.0, _ones(), hooks=[StopAtStepHook(10)], start_step=7)
+    loop.run()
+    assert loop.step == 10  # resumed loops run only the remaining steps
