@@ -57,6 +57,52 @@ def synthetic_mnist(batch_size: int, seed: int = 0) -> SyntheticClassification:
     return SyntheticClassification(batch_size, seed=seed)
 
 
+class SyntheticCTR:
+    """Click-through batches for the Wide&Deep config: categorical ids +
+    dense features, labels from a fixed logistic ground truth (learnable,
+    deterministic)."""
+
+    def __init__(
+        self,
+        batch_size: int,
+        *,
+        vocab_sizes=(1000, 1000, 100, 100, 10),
+        num_dense: int = 8,
+        seed: int = 0,
+    ):
+        self.batch_size = batch_size
+        self.vocab_sizes = tuple(vocab_sizes)
+        self.num_dense = num_dense
+        gt = np.random.RandomState(seed)
+        # ground-truth per-id weights + dense weights defining p(click)
+        self._id_w = [gt.randn(v).astype(np.float32) * 0.5 for v in self.vocab_sizes]
+        self._dense_w = gt.randn(num_dense).astype(np.float32) * 0.5
+        self._rng = np.random.RandomState(seed + 1)
+
+    def __iter__(self):
+        while True:
+            cat = np.stack(
+                [
+                    self._rng.randint(0, v, self.batch_size)
+                    for v in self.vocab_sizes
+                ],
+                axis=1,
+            ).astype(np.int32)
+            dense = self._rng.randn(self.batch_size, self.num_dense).astype(
+                np.float32
+            )
+            logit = dense @ self._dense_w + sum(
+                self._id_w[i][cat[:, i]] for i in range(len(self.vocab_sizes))
+            )
+            p = 1.0 / (1.0 + np.exp(-logit))
+            label = (self._rng.rand(self.batch_size) < p).astype(np.int32)
+            yield {"cat": cat, "dense": dense, "label": label}
+
+    def take(self, n: int) -> list[dict]:
+        it = iter(self)
+        return [next(it) for _ in range(n)]
+
+
 def synthetic_imagenet(
     batch_size: int, image_size: int = 224, seed: int = 0
 ) -> SyntheticClassification:

@@ -1,0 +1,128 @@
+"""ResNet — judged config 2: "ResNet-50 ImageNet MultiWorkerMirroredStrategy
+(NCCL allreduce → lax.psum)" (BASELINE.md), the north-star throughput model.
+
+Reference context: the guide's multi-GPU tower example (⚠
+Multiple-GPUs-Single-Machine/) replicates a model per GPU and averages tower
+gradients on the CPU — the hand-rolled MirroredStrategy
+(tensorflow/python/distribute/mirrored_strategy.py:200). Here the replication
+is SPMD over the data mesh axis and the average is one ICI psum
+(parallel/data_parallel.py).
+
+TPU-first choices:
+  * NHWC layout, bf16 activations/f32 params (MXU-native mixed precision)
+  * BatchNorm stats are *local* per step and cross-replica pmean-ed along
+    with gradients (sync running stats — the MultiWorkerMirrored behavior)
+  * stride-2 3x3 center conv in the bottleneck (the "v1.5" variant every
+    modern benchmark uses)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides, use_bias=False)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1), use_bias=False)(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, use_bias=False,
+                name="conv_proj",
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    small_inputs: bool = False  # skip the stride-4 stem for <=64px images
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        if self.small_inputs:
+            x = conv(self.num_filters, (3, 3), use_bias=False, name="conv_init")(x)
+        else:
+            x = conv(
+                self.num_filters, (7, 7), (2, 2),
+                padding=[(3, 3), (3, 3)], use_bias=False, name="conv_init",
+            )(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(
+                    self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+ResNet50 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3))
+ResNet18ish = functools.partial(ResNet, stage_sizes=(1, 1, 1, 1))  # test-sized
+
+
+def make_loss_fn(model: ResNet, weight_decay: float = 0.0):
+    """``(params, model_state, batch) -> (loss, (metrics, new_model_state))``
+    for :meth:`DataParallel.make_train_step_with_stats`."""
+
+    def loss_fn(params, model_state, batch):
+        logits, new_model_state = model.apply(
+            {"params": params, **model_state},
+            batch["image"],
+            train=True,
+            mutable=["batch_stats"],
+        )
+        labels = batch["label"]
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+        if weight_decay:
+            loss = loss + 0.5 * weight_decay * sum(
+                jnp.sum(p.astype(jnp.float32) ** 2)
+                for p in jax.tree.leaves(params)
+                if p.ndim > 1  # skip BN scales/biases
+            )
+        acc = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return loss, ({"accuracy": acc}, new_model_state)
+
+    return loss_fn

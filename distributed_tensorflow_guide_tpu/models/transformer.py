@@ -1,0 +1,236 @@
+"""Transformer family — shared backbone for judged configs 3 (BERT-base TP)
+and 5 (GPT-2 124M PP), with Megatron-style tensor-parallel annotations.
+
+No transformer exists in the reference (its largest model is a small CNN);
+these configs come from BASELINE.json. The tensor-parallel design follows
+the Megatron factorization (Shoeybi et al. 2019) expressed the JAX way:
+parameters carry *logical* axis names via ``nn.with_logical_partitioning``,
+``parallel/tensor.py`` maps logical names → mesh axes
+(vocab/mlp/heads → "model"), and XLA inserts the collectives that Megatron
+hand-writes as NCCL calls (the north-star mapping: NCCL allreduce →
+``lax.psum``, here implicit through ``pjit`` shardings).
+
+Logical axes: "batch", "seq", "embed" (d_model), "mlp" (d_ff),
+"heads", "kv" (head_dim), "vocab".
+
+TPU-first: bf16 activations / f32 params; d_ff and head counts MXU-friendly;
+optional ``jax.checkpoint`` rematerialization per block (HBM ↔ FLOPs trade);
+static shapes throughout (fixed seq_len — no dynamic padding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_len: int = 1024
+    causal: bool = True
+    dtype: Dtype = jnp.bfloat16
+    remat: bool = False
+    num_classes: int | None = None  # set → classification head (BERT/GLUE)
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.num_heads == 0
+        return self.d_model // self.num_heads
+
+
+def gpt2_124m(**kw) -> TransformerConfig:
+    """GPT-2 small (124M): 12L, 768d, 12h, causal. Vocab 50257 padded to
+    50304 (multiple of 128) so the vocab dim shards evenly over any model
+    axis and tiles the MXU — the standard Megatron-style padding."""
+    return TransformerConfig(
+        vocab_size=50304, num_layers=12, num_heads=12, d_model=768,
+        d_ff=3072, max_len=1024, causal=True, **kw,
+    )
+
+
+def bert_base(num_classes: int = 2, **kw) -> TransformerConfig:
+    """BERT-base (110M): 12L, 768d, 12h, bidirectional. Vocab 30522 padded
+    to 30592 (multiple of 128) for even vocab sharding / MXU tiling."""
+    return TransformerConfig(
+        vocab_size=30592, num_layers=12, num_heads=12, d_model=768,
+        d_ff=3072, max_len=512, causal=False, num_classes=num_classes, **kw,
+    )
+
+
+def _dense_init(*names):
+    return nn.with_logical_partitioning(
+        nn.initializers.normal(stddev=0.02), names
+    )
+
+
+class MultiHeadAttention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:  # (B, S, D)
+        cfg = self.cfg
+        h, hd = cfg.num_heads, cfg.head_dim
+        qkv = nn.DenseGeneral(
+            (3, h, hd),
+            axis=-1,
+            dtype=cfg.dtype,
+            kernel_init=_dense_init("embed", "qkv", "heads", "kv"),
+            use_bias=False,
+            name="qkv",
+        )(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B, S, H, hd)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
+        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(
+            cfg.dtype
+        )
+        if cfg.causal:
+            s = x.shape[1]
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            scores = jnp.where(mask[None, None], scores, jnp.finfo(cfg.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = nn.DenseGeneral(
+            cfg.d_model,
+            axis=(-2, -1),
+            dtype=cfg.dtype,
+            kernel_init=_dense_init("heads", "kv", "embed"),
+            use_bias=False,
+            name="proj",
+        )(out)
+        return out
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        y = nn.Dense(
+            cfg.d_ff,
+            dtype=cfg.dtype,
+            kernel_init=_dense_init("embed", "mlp"),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("mlp",)
+            ),
+            name="up",
+        )(x)
+        y = nn.gelu(y)
+        y = nn.with_logical_constraint(y, ("batch", "seq", "mlp"))
+        y = nn.Dense(
+            cfg.d_model,
+            dtype=cfg.dtype,
+            kernel_init=_dense_init("mlp", "embed"),
+            use_bias=False,
+            name="down",
+        )(y)
+        return y
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block: x + attn(LN(x)); x + mlp(LN(x))."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        x = x + MultiHeadAttention(cfg, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+        )
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+        )
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class Transformer(nn.Module):
+    """Token-in, logits-out. ``cfg.num_classes`` set → [CLS]-pooled
+    classification logits (BERT/GLUE); otherwise per-token LM logits."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:  # (B, S) int32
+        cfg = self.cfg
+        x = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            embedding_init=_dense_init("vocab", "embed"),
+            name="tok_emb",
+        )(tokens)
+        pos = nn.Embed(
+            cfg.max_len,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            embedding_init=_dense_init("seq", "embed"),
+            name="pos_emb",
+        )(jnp.arange(tokens.shape[1])[None, :])
+        x = x + pos
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False)
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+
+        if cfg.num_classes is not None:
+            cls = x[:, 0]  # [CLS] pooling
+            return nn.Dense(
+                cfg.num_classes, dtype=jnp.float32, name="classifier"
+            )(cls)
+        logits = nn.Dense(
+            cfg.vocab_size,
+            dtype=jnp.float32,
+            use_bias=False,
+            kernel_init=_dense_init("embed", "vocab"),
+            name="lm_head",
+        )(x)
+        return logits
+
+
+def make_lm_loss_fn(model: Transformer):
+    """Next-token LM loss: ``(params, batch{tokens}) -> (loss, metrics)``."""
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        logits = model.apply({"params": params}, tokens)  # (B, S, V)
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1])
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss = -jnp.mean(ll)
+        return loss, {"perplexity": jnp.exp(loss)}
+
+    return loss_fn
+
+
+def make_cls_loss_fn(model: Transformer):
+    """Sequence classification (GLUE-style): batch {tokens, label}."""
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["tokens"])
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(
+            jnp.take_along_axis(logp, batch["label"][:, None], axis=1)
+        )
+        acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        return loss, {"accuracy": acc}
+
+    return loss_fn

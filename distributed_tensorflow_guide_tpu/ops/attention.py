@@ -1,0 +1,111 @@
+"""Blockwise (online-softmax) attention — the math under ring attention and
+the Pallas flash kernel.
+
+Nothing like this exists in the reference (SURVEY.md §5 long-context row:
+"Absent — guide predates it"); it is mandated by the build spec. The
+formulation is the numerically-stable streaming softmax (Milakov & Gimelshein
+2018; FlashAttention, Dao et al. 2022; Blockwise/Ring Attention, Liu et al.
+2023): process KV in blocks, carrying a running row-max ``m``, normalizer
+``l`` and *unnormalized* output accumulator ``o``:
+
+    m' = max(m, rowmax(s))        s = q k^T * scale  (+ mask)
+    a  = exp(m - m')
+    l' = l * a + rowsum(exp(s - m'))
+    o' = o * a + exp(s - m') @ v
+
+Normalizing by ``l`` only at the end makes the update associative over KV
+blocks — which is exactly what lets blocks live on different chips and
+rotate around the ICI ring (parallel/sequence.py).
+
+All shapes are (B, S, H, D) — NHWC-analogue layout, matching
+models/transformer.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-but-finite: keeps exp() exact zeros without NaNs
+
+
+def block_update(q, k, v, m, l, o, *, scale: float, mask=None):
+    """One online-softmax accumulation step over a KV block.
+
+    q: (B, Sq, H, D); k, v: (B, Skv, H, D)
+    m, l: (B, H, Sq); o: (B, Sq, H, D) unnormalized.
+    mask: broadcastable to (B, H, Sq, Skv); True = attend.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # f32 accumulation
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    alpha = jnp.exp(m - m_new)  # (B, H, Sq)
+    p = jnp.exp(s - m_new[..., None])  # (B, H, Sq, Skv)
+    # A fully-masked row has m_new == NEG_INF and s - m_new == 0 there, so
+    # exp() would emit spurious 1s; force masked entries to exactly 0 so the
+    # row's l stays 0 and finalize() returns 0 as documented.
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v
+    )
+    return m_new, l_new, o_new
+
+
+def init_carry(q_shape, dtype=jnp.float32):
+    """(m, l, o) identities for the streaming softmax."""
+    b, sq, h, d = q_shape
+    m = jnp.full((b, h, sq), NEG_INF, dtype)
+    l = jnp.zeros((b, h, sq), dtype)
+    o = jnp.zeros((b, sq, h, d), dtype)
+    return m, l, o
+
+
+def finalize(m, l, o):
+    """Normalize the accumulator. Rows that attended nothing return 0."""
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return o / safe_l.transpose(0, 2, 1)[..., None]
+
+
+def blockwise_attention(q, k, v, *, causal: bool = False,
+                        block_size: int = 512):
+    """Full attention computed KV-block by KV-block (single device).
+
+    Numerically equivalent to dense softmax attention — the unit test for
+    the streaming-softmax algebra, and the CPU/interpret reference for the
+    Pallas kernel and the ring layout.
+    """
+    b, s, hn, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    n_blocks = -(-s // block_size)
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    m, l, o = init_carry(q.shape)
+    q_pos = jnp.arange(s)
+
+    def body(carry, j):
+        m, l, o = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(kf, j * block_size, block_size, 1)
+        v_blk = jax.lax.dynamic_slice_in_dim(vf, j * block_size, block_size, 1)
+        mask = None
+        if causal:
+            kv_pos = j * block_size + jnp.arange(block_size)
+            mask = (q_pos[:, None] >= kv_pos[None, :])[None, None]
+        m, l, o = block_update(qf, k_blk, v_blk, m, l, o, scale=scale, mask=mask)
+        return (m, l, o), None
+
+    (m, l, o), _ = jax.lax.scan(body, (m, l, o), jnp.arange(n_blocks))
+    return finalize(m, l, o).astype(q.dtype)
+
+
+def dense_attention(q, k, v, *, causal: bool = False):
+    """Plain softmax attention (the oracle for parity tests)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / (d ** 0.5)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -55,6 +55,22 @@ class DataParallel:
         return jax.device_put(state, sharding)
 
     # ---- compiled steps ----------------------------------------------------
+    def _compile_step(self, sm_step, donate: bool):
+        """shard_map + jit a per-device ``(state, batch) -> (state, metrics)``
+        body: state replicated, batch sharded on its leading axis,
+        explicit collectives (hence check_vma=False)."""
+        sharded = jax.shard_map(
+            sm_step,
+            mesh=self.mesh,
+            in_specs=(P(), P(self.axis)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+    def _pmean_metrics(self, mets: dict) -> dict:
+        return {k: cc.pmean(v, self.axis) for k, v in mets.items()}
+
     def make_train_step(self, loss_fn: LossFn, *, donate: bool = True):
         """Compile ``(state, batch) -> (state, metrics)``.
 
@@ -69,19 +85,34 @@ class DataParallel:
                 state.params, batch
             )
             grads = cc.pmean(grads, self.axis)
-            mets = {"loss": loss, **mets}
-            mets = {k: cc.pmean(v, self.axis) for k, v in mets.items()}
             state = state.apply_gradients(grads=grads)
-            return state, mets
+            return state, self._pmean_metrics({"loss": loss, **mets})
 
-        sharded = jax.shard_map(
-            sm_step,
-            mesh=self.mesh,
-            in_specs=(P(), P(self.axis)),
-            out_specs=(P(), P()),
-            check_vma=False,
-        )
-        return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+        return self._compile_step(sm_step, donate)
+
+    def make_train_step_with_stats(self, loss_fn, *, donate: bool = True):
+        """Like :meth:`make_train_step` for models with non-trainable state
+        (BatchNorm running stats).
+
+        ``loss_fn(params, model_state, batch) ->
+        (loss, (metrics, new_model_state))``; ``state`` is a
+        :class:`~distributed_tensorflow_guide_tpu.train.state.TrainStateWithStats`.
+        New model state is pmean-ed across replicas — synchronized running
+        statistics, matching MultiWorkerMirroredStrategy's aggregation of
+        BN updates rather than the reference PS examples' last-writer-wins
+        race on PS-resident stats.
+        """
+
+        def sm_step(state, batch):
+            (loss, (mets, new_ms)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params, state.model_state, batch)
+            grads = cc.pmean(grads, self.axis)
+            new_ms = cc.pmean(new_ms, self.axis)
+            state = state.apply_gradients(grads=grads, model_state=new_ms)
+            return state, self._pmean_metrics({"loss": loss, **mets})
+
+        return self._compile_step(sm_step, donate)
 
     def make_eval_step(self, metric_fn: Callable[[Any, Any], dict]):
         """Compile ``(state, batch) -> metrics`` with pmean-ed metrics."""

@@ -1,0 +1,241 @@
+"""Pipeline parallelism — judged config 5: "GPT-2 124M pipeline-parallel
+across a v5e-16 pod slice" (BASELINE.md).
+
+No pipeline exists in the reference (SURVEY.md §2c). Design: GPipe microbatch
+schedule (Huang et al. 2019) expressed as ONE compiled SPMD program — the
+pipeline "stages" are not processes (the reference's only composition
+mechanism) but shards of a stacked-layer parameter tree over the ``pipe``
+mesh axis, and the stage-to-stage hand-off is a single ICI-neighbor
+``lax.ppermute`` per tick inside a ``lax.scan``:
+
+    tick t:  stage 0 injects microbatch t | stage s runs layers on the
+             activation it received at t-1 | everyone ppermutes output to s+1
+
+    M microbatches, P stages → M+P-1 ticks; bubble fraction (P-1)/(M+P-1).
+
+Differentiating *through* the scan+ppermute gives the backward pipeline for
+free (ppermute's transpose is the reverse ppermute) — no hand-written
+backward schedule, no send/recv pairs to keep in sync.
+
+Embedding params live logically on stage 0 and head params on stage P-1:
+every stage holds a copy, but only the owning stage's compute reaches the
+loss, so the others' grads are structurally zero and one ``psum`` over
+``pipe`` reconstitutes the true gradient. Composes with data parallelism
+(``data`` axis pmean) in the same shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.core.mesh import axis_sizes
+from distributed_tensorflow_guide_tpu.utils.spec_utils import (
+    assign_by_shape,
+    expand_prefix,
+)
+from distributed_tensorflow_guide_tpu.models.transformer import (
+    Block,
+    TransformerConfig,
+)
+
+
+class _Embedder(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                     name="tok_emb")(tokens)
+        pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=cfg.dtype,
+                       name="pos_emb")(jnp.arange(tokens.shape[1])[None, :])
+        return x + pos
+
+
+class _Head(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=self.cfg.dtype, name="ln_f")(x)
+        return nn.Dense(self.cfg.vocab_size, dtype=jnp.float32, use_bias=False,
+                        name="lm_head")(x)
+
+
+class PipelinedLM:
+    """GPipe LM training over the ``pipe`` (× ``data``) mesh axes."""
+
+    def __init__(self, mesh: Mesh, cfg: TransformerConfig,
+                 num_microbatches: int):
+        self.mesh = mesh
+        self.cfg = cfg
+        sizes = axis_sizes(mesh)
+        self.n_stages = sizes["pipe"]
+        self.n_data = sizes["data"]
+        self.num_microbatches = num_microbatches
+        if cfg.num_layers % self.n_stages:
+            raise ValueError(
+                f"{cfg.num_layers} layers not divisible by {self.n_stages} stages"
+            )
+        self.layers_per_stage = cfg.num_layers // self.n_stages
+        self.embedder = _Embedder(cfg)
+        self.head = _Head(cfg)
+        self.block = Block(cfg)
+
+    # -- params ---------------------------------------------------------------
+    def init_params(self, rng) -> dict:
+        cfg = self.cfg
+        r_emb, r_blocks, r_head = jax.random.split(rng, 3)
+        dummy_tok = jnp.zeros((1, cfg.max_len), jnp.int32)
+        emb = self.embedder.init(r_emb, dummy_tok)["params"]
+        dummy_x = jnp.zeros((1, cfg.max_len, cfg.d_model), cfg.dtype)
+
+        keys = jax.random.split(r_blocks, cfg.num_layers)
+        stacked = jax.vmap(
+            lambda k: self.block.init(k, dummy_x)["params"]
+        )(keys)
+        stacked = jax.tree.map(
+            lambda x: x.reshape(self.n_stages, self.layers_per_stage, *x.shape[1:]),
+            stacked,
+        )
+        head = self.head.init(r_head, dummy_x)["params"]
+        params = {"embed": emb, "stages": stacked, "head": head}
+        return jax.device_put(params, self.param_shardings())
+
+    def param_specs(self) -> dict:
+        """Prefix spec tree: stage stack sharded over pipe, rest replicated."""
+        return {"embed": P(), "stages": P("pipe"), "head": P()}
+
+    def param_shardings(self):
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            self.param_specs(),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def opt_state_specs(self, tx: optax.GradientTransformation, params):
+        """Specs for the optimizer state: moments inherit their param's spec
+        (matched by shape+dtype — stage stacks have a distinctive leading
+        n_stages dim), counts/scalars replicate."""
+        full = expand_prefix(self.param_specs(), params)
+        return assign_by_shape(params, full, jax.eval_shape(tx.init, params), P())
+
+    # -- the schedule ---------------------------------------------------------
+    def _stage_apply(self, stage_params, x):
+        """Run this stage's ``layers_per_stage`` blocks (scan over layers)."""
+
+        def body(h, layer_params):
+            return self.block.apply({"params": layer_params}, h), None
+
+        out, _ = lax.scan(body, x, stage_params)
+        return out
+
+    def _pipeline_loss(self, params, tokens_mbs):
+        """Per-device pipeline forward + LM loss.
+
+        tokens_mbs: (M, mb, S) — this data-shard's microbatches.
+        Returns mean next-token loss over all microbatches.
+        """
+        cfg = self.cfg
+        M, mb, S = tokens_mbs.shape
+        n_stages = self.n_stages
+        stage = lax.axis_index("pipe")
+        stage_params = jax.tree.map(lambda x: x[0], params["stages"])
+        fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            received, loss_sum = carry
+            # stage 0 injects microbatch t (clamped during drain ticks)
+            inject_idx = jnp.clip(t, 0, M - 1)
+            toks_in = lax.dynamic_index_in_dim(
+                tokens_mbs, inject_idx, axis=0, keepdims=False
+            )
+            injected = self.embedder.apply({"params": params["embed"]}, toks_in)
+            x_in = jnp.where(stage == 0, injected, received)
+            x_out = self._stage_apply(stage_params, x_in)
+
+            # last stage finishes microbatch m = t - (P-1)
+            m_idx = t - (n_stages - 1)
+            valid = jnp.logical_and(stage == n_stages - 1, m_idx >= 0)
+            toks_out = lax.dynamic_index_in_dim(
+                tokens_mbs, jnp.clip(m_idx, 0, M - 1), axis=0, keepdims=False
+            )
+            logits = self.head.apply({"params": params["head"]}, x_out)
+            logp = jax.nn.log_softmax(logits[:, :-1])
+            ll = jnp.take_along_axis(
+                logp, toks_out[:, 1:][..., None], axis=-1
+            )[..., 0]
+            mb_loss = -jnp.mean(ll)
+            loss_sum = loss_sum + jnp.where(valid, mb_loss, 0.0)
+
+            received = cc.ppermute(x_out, "pipe", fwd)
+            return (received, loss_sum), None
+
+        x0 = jnp.zeros((mb, S, cfg.d_model), cfg.dtype)
+        (_, loss_sum), _ = lax.scan(
+            tick, (x0, jnp.float32(0.0)), jnp.arange(M + n_stages - 1)
+        )
+        # LOCAL loss: nonzero only on the last stage. Do NOT psum here — the
+        # transpose of psum under shard_map is another psum, which would
+        # multiply every cotangent by n_stages. Differentiating the local
+        # value is exact: cotangents reach earlier stages back through the
+        # ppermute transposes (the backward pipeline). The caller psums the
+        # VALUE for reporting.
+        return loss_sum / M
+
+    # -- compiled step --------------------------------------------------------
+    def make_train_step(self, tx: optax.GradientTransformation, params,
+                        *, donate: bool = True):
+        """``(opt_state, params, batch{tokens:(B,S)}) -> (opt_state, params,
+        metrics)`` — B = n_data * num_microbatches * microbatch_size.
+        ``params`` is used only to derive optimizer-state specs."""
+        M = self.num_microbatches
+        opt_specs = self.opt_state_specs(tx, params)
+
+        def sm_step(opt_state, params, tokens):
+            mbs = tokens.reshape(M, tokens.shape[0] // M, tokens.shape[1])
+            local_loss, grads = jax.value_and_grad(self._pipeline_loss)(
+                params, mbs
+            )
+            loss = cc.psum(local_loss, "pipe")  # value only; see _pipeline_loss
+            # embed/head grads are nonzero only on their owning stage;
+            # stage grads are per-stage (no pipe reduction needed)
+            grads = {
+                "embed": cc.psum(grads["embed"], "pipe"),
+                "stages": grads["stages"],
+                "head": cc.psum(grads["head"], "pipe"),
+            }
+            if self.n_data > 1:
+                grads = cc.pmean(grads, "data")
+                loss = cc.pmean(loss, "data")
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return opt_state, params, {"loss": loss}
+
+        sharded = jax.shard_map(
+            sm_step,
+            mesh=self.mesh,
+            in_specs=(opt_specs, self.param_specs(), P("data")),
+            out_specs=(opt_specs, self.param_specs(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+
+    def init_opt_state(self, tx, params):
+        """Optimizer state materialized directly into its shard layout."""
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            self.opt_state_specs(tx, params),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        with self.mesh:
+            return jax.jit(tx.init, out_shardings=shardings)(params)

@@ -1,0 +1,120 @@
+"""Host-side emulation of TRUE async parameter-server semantics.
+
+The device strategies in :mod:`.async_ps` are the production TPU mappings;
+they are synchronous by construction. This module preserves the reference's
+*actual* semantics — stale parameter reads, interleaved writes, per-worker
+pacing — so tests can quantify the semantic delta (SURVEY.md §2c: "keep a
+host-side async-PS emulation for parity testing").
+
+Model (one "event" = one worker micro-step, order given by a seeded
+pseudorandom schedule — the emulated nondeterminism of N racing processes):
+
+  * ``hogwild``  (⚠ Hogwild/):  worker pulls fresh PS params, computes a
+    gradient, applies it directly to PS params (SGD on the PS, lock-free;
+    fetch_period is forced to 1).
+  * ``downpour`` (⚠ DOWNPOUR/): worker keeps a local replica, trains it
+    locally each event, and every ``fetch_period`` of ITS events pushes the
+    accumulated parameter delta to the PS and pulls fresh params.
+  * ``adag``     (⚠ ADAG/):     worker accumulates raw gradients on stale
+    params; every ``fetch_period`` events pushes them; the PS applies an
+    adaptive optax optimizer (the PS-resident Adam).
+
+Gradients run through jitted JAX; the PS itself is plain host state —
+exactly the reference's architecture, scaled down to one process.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+GradFn = Callable[[Any, Any], tuple[jax.Array, Any]]  # (loss, grads)
+
+
+class AsyncPSEmulator:
+    def __init__(
+        self,
+        loss_fn: Callable[[Any, Any], jax.Array],
+        params: Any,
+        *,
+        n_workers: int,
+        mode: str = "hogwild",
+        lr: float = 0.1,
+        fetch_period: int = 1,
+        tx: optax.GradientTransformation | None = None,
+        seed: int = 0,
+    ):
+        if mode not in ("hogwild", "downpour", "adag"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.mode = mode
+        self.lr = lr
+        self.fetch_period = 1 if mode == "hogwild" else fetch_period
+        self.n_workers = n_workers
+        self.ps_params = jax.tree.map(jnp.asarray, params)
+        self._grad = jax.jit(jax.value_and_grad(loss_fn))
+        self._rng = np.random.RandomState(seed)
+        if mode == "adag":
+            self.tx = tx or optax.adam(lr)
+            self.tx_state = self.tx.init(self.ps_params)
+        # per-worker local replicas / accumulators / event counts
+        self.local = [self.ps_params for _ in range(n_workers)]
+        self.accum = [
+            jax.tree.map(jnp.zeros_like, self.ps_params) for _ in range(n_workers)
+        ]
+        self.events = [0] * n_workers
+        self.pushes = 0
+
+    # -- PS ops ---------------------------------------------------------------
+    def _push_pull(self, k: int) -> None:
+        """Worker k pushes its accumulated delta/grads; pulls fresh params."""
+        self.pushes += 1
+        if self.mode == "adag":
+            g = jax.tree.map(lambda a: a / self.fetch_period, self.accum[k])
+            updates, self.tx_state = self.tx.update(
+                g, self.tx_state, self.ps_params
+            )
+            self.ps_params = optax.apply_updates(self.ps_params, updates)
+        else:
+            self.ps_params = jax.tree.map(
+                jnp.add, self.ps_params, self.accum[k]
+            )
+        self.accum[k] = jax.tree.map(jnp.zeros_like, self.accum[k])
+        self.local[k] = self.ps_params
+
+    def _event(self, k: int, batch: Any) -> float:
+        """One micro-step of worker k."""
+        if self.mode == "hogwild":
+            # fresh read of PS-resident params (no local replica at all) +
+            # direct racing write back — staleness comes only from the
+            # interleaving of other workers' events, as in true Hogwild
+            loss, g = self._grad(self.ps_params, batch)
+            delta = jax.tree.map(lambda gg: -self.lr * gg, g)
+            self.accum[k] = delta
+            self._push_pull(k)
+            return float(loss)
+        loss, g = self._grad(self.local[k], batch)
+        if self.mode == "downpour":
+            delta = jax.tree.map(lambda gg: -self.lr * gg, g)
+            self.local[k] = optax.apply_updates(self.local[k], delta)
+            self.accum[k] = jax.tree.map(jnp.add, self.accum[k], delta)
+            self.events[k] += 1
+            if self.events[k] % self.fetch_period == 0:
+                self._push_pull(k)
+        else:  # adag: accumulate raw grads on stale params
+            self.accum[k] = jax.tree.map(jnp.add, self.accum[k], g)
+            self.events[k] += 1
+            if self.events[k] % self.fetch_period == 0:
+                self._push_pull(k)
+        return float(loss)
+
+    def run(self, data: Iterator[Any], n_events: int) -> list[float]:
+        """Interleave ``n_events`` worker micro-steps in pseudorandom order."""
+        losses = []
+        for _ in range(n_events):
+            k = int(self._rng.randint(self.n_workers))
+            losses.append(self._event(k, next(data)))
+        return losses

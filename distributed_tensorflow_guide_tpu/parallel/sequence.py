@@ -1,0 +1,94 @@
+"""Sequence/context parallelism: ring attention + Ulysses — first-class per
+the build mandate (SURVEY.md §5 long-context row; absent from the reference).
+
+Two standard layouts over the ``context`` mesh axis:
+
+* **Ring attention** (Liu et al. 2023): Q/K/V are sequence-sharded; each of
+  the ``n`` devices computes blockwise attention of its local Q against the
+  KV block it currently holds, then rotates KV one hop around the ICI ring
+  (``lax.ppermute``) — after ``n`` steps every Q block has seen every KV
+  block, with per-device memory O(S/n) and only neighbor communication.
+  The online-softmax carry (ops/attention.py) is what makes the partial
+  results mergeable. Causality is enforced per (q-block, kv-block) pair:
+  blocks strictly above the diagonal are skipped-by-masking.
+
+* **Ulysses** (Jacobs et al. 2023): ``all_to_all`` reshards sequence ↔ heads
+  around the attention core, so attention itself runs with full sequence on
+  1/n of the heads — one transpose-style collective each way, no per-step
+  ring traffic. Better when heads ≥ ring size and S/n is small.
+
+Both compose with data parallelism (batch over ``data``) in one shard_map.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.ops import attention as A
+
+
+def ring_attention(q, k, v, *, axis: str = "context", causal: bool = False):
+    """Sequence-sharded attention over the ``axis`` ring.
+
+    Per-device shapes (B, S_local, H, D); the global sequence is the
+    concatenation of shards in axis order. Must run inside shard_map.
+    """
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    s_local = q.shape[1]
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    qf = q.astype(jnp.float32)
+    m, l, o = A.init_carry(q.shape)
+    q_pos = my * s_local + jnp.arange(s_local)
+
+    def body(carry, step):
+        m, l, o, k_cur, v_cur, src = carry
+        if causal:
+            kv_pos = src * s_local + jnp.arange(s_local)
+            mask = (q_pos[:, None] >= kv_pos[None, :])[None, None]
+        else:
+            mask = None
+        m, l, o = A.block_update(
+            qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+            m, l, o, scale=scale, mask=mask,
+        )
+        # rotate KV to the next device; the block we receive came from the
+        # previous rank, so its global offset decrements by one each step
+        k_cur = cc.ppermute(k_cur, axis, fwd)
+        v_cur = cc.ppermute(v_cur, axis, fwd)
+        src = (src - 1) % n
+        return (m, l, o, k_cur, v_cur, src), None
+
+    (m, l, o, _, _, _), _ = lax.scan(
+        body, (m, l, o, k, v, my), jnp.arange(n)
+    )
+    return A.finalize(m, l, o).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis: str = "context",
+                      causal: bool = False):
+    """Ulysses: all_to_all seq→heads, full-sequence attention on a head
+    shard, all_to_all heads→seq back.
+
+    Per-device in/out: (B, S_local, H, D); requires H % axis_size == 0.
+    """
+    n = lax.axis_size(axis)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(f"num_heads {h} must divide context size {n}")
+
+    def to_heads(x):  # (B, S/n, H, D) -> (B, S, H/n, D)
+        return cc.all_to_all(x, axis, split_axis=2, concat_axis=1)
+
+    def to_seq(x):  # (B, S, H/n, D) -> (B, S/n, H, D)
+        return cc.all_to_all(x, axis, split_axis=1, concat_axis=2)
+
+    out = A.dense_attention(
+        to_heads(q), to_heads(k), to_heads(v), causal=causal
+    )
+    return to_seq(out)

@@ -1,0 +1,99 @@
+"""Tensor parallelism via pjit/NamedSharding — judged config 3: "BERT-base
+GLUE under ParameterServerStrategy → pjit param-sharded" (BASELINE.md).
+
+Reference context: ParameterServerStrategyV2
+(tensorflow/python/distribute/parameter_server_strategy_v2.py:77) shards
+*whole variables* round-robin across PS tasks and moves them over gRPC every
+step. The TPU inversion shards *inside* each tensor over the ``model`` mesh
+axis (Megatron factorization, annotated in models/transformer.py), keeps
+every shard pinned in its chip's HBM, and lets XLA insert the allreduces
+where the math needs them — communication becomes a property of the program,
+not of parameter placement.
+
+The GSPMD contract: we only (1) lay out params per the logical rules,
+(2) shard the batch over ``data``, (3) constrain activations inside the
+model; the compiler derives every collective.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+from flax.linen import spmd
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_guide_tpu.utils.spec_utils import assign_by_shape
+
+# logical axis name -> mesh axis (None = replicated)
+DEFAULT_RULES = (
+    ("batch", "data"),
+    ("seq", None),       # sequence stays unsharded under pure TP; the
+                         # context axis takes it in parallel/sequence.py
+    ("embed", None),
+    ("qkv", None),
+    ("mlp", "model"),
+    ("heads", "model"),
+    ("kv", None),
+    ("vocab", "model"),
+)
+
+LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
+
+
+class TensorParallel:
+    """Parameter-sharded training over the ``model`` mesh axis."""
+
+    def __init__(self, mesh: Mesh, rules=DEFAULT_RULES):
+        self.mesh = mesh
+        self.rules = list(rules)
+
+    # -- layout ---------------------------------------------------------------
+    def init_params(self, model: nn.Module, rng, *sample_args):
+        """Initialize with every param materialized directly into its shard
+        layout (no host-side full copy — how 100B-param states fit)."""
+
+        def init_fn():
+            return model.init(rng, *sample_args)
+
+        abstract = jax.eval_shape(init_fn)
+        specs = nn.get_partition_spec(abstract)
+        shardings = spmd.logical_to_mesh_sharding(specs, self.mesh, self.rules)
+        with self.mesh:
+            variables = jax.jit(init_fn, out_shardings=shardings)()
+        params = nn.meta.unbox(variables)["params"]
+        param_shardings = nn.meta.unbox(shardings)["params"]
+        return params, param_shardings
+
+    def state_shardings(self, state: Any, param_shardings: Any) -> Any:
+        """Shardings for a full TrainState: optimizer moments inherit their
+        param's sharding (matched by shape+dtype), scalars replicate."""
+        return assign_by_shape(
+            state.params, param_shardings, state,
+            NamedSharding(self.mesh, P()),
+        )
+
+    # -- compiled steps -------------------------------------------------------
+    def make_train_step(self, loss_fn: LossFn, state_shardings: Any,
+                        *, donate: bool = True):
+        """jit the step with explicit in/out shardings; GSPMD derives the
+        collectives (the reference's gRPC push/pull has no analogue here —
+        nothing moves except the math's own allreduces)."""
+        batch_sharding = NamedSharding(self.mesh, P("data"))
+
+        def step(state, batch):
+            with nn.logical_axis_rules(self.rules):
+                (loss, mets), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(state.params, batch)
+            state = state.apply_gradients(grads=grads)
+            return state, {"loss": loss, **mets}
+
+        return jax.jit(
+            step,
+            in_shardings=(state_shardings, batch_sharding),
+            out_shardings=(state_shardings, NamedSharding(self.mesh, P())),
+            donate_argnums=(0,) if donate else (),
+        )

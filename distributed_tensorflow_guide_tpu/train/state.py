@@ -1,0 +1,18 @@
+"""Train states.
+
+``TrainStateWithStats`` extends the plain flax TrainState with non-trainable
+model state (BatchNorm running statistics). In the reference those live as PS
+variables updated by whichever worker writes last (a benign race in the async
+examples); here they are replicated and kept in sync by pmean-ing each step's
+local stats across the data axis (parallel/data_parallel.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from flax.training import train_state
+
+
+class TrainStateWithStats(train_state.TrainState):
+    model_state: Any = None

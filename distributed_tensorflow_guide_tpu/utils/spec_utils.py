@@ -1,0 +1,38 @@
+"""Sharding-assignment helpers shared by the parallel strategies."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def assign_by_shape(ref_tree: Any, ref_assignments: Any, target_tree: Any,
+                    default: Any) -> Any:
+    """Map each leaf of ``target_tree`` to the assignment of the ``ref_tree``
+    leaf with the same (shape, dtype), else ``default``.
+
+    The standard trick for laying out optimizer state: optax moments (mu, nu,
+    trace, ...) are copies of the param tree, so matching by shape+dtype
+    recovers each moment's param sharding; counts and scalars fall through to
+    ``default`` (replicated). First match wins on collisions — identical
+    shapes with different assignments would need path-based matching instead.
+    """
+    def key(leaf):
+        # python scalars (e.g. TrainState.step == 0) have no shape/dtype
+        return (tuple(getattr(leaf, "shape", ())), getattr(leaf, "dtype", None))
+
+    lookup: dict = {}
+    for leaf, a in zip(
+        jax.tree.leaves(ref_tree), jax.tree.leaves(ref_assignments)
+    ):
+        lookup.setdefault(key(leaf), a)
+    return jax.tree.map(lambda l: lookup.get(key(l), default), target_tree)
+
+
+def expand_prefix(prefix_assignments: dict, tree: dict) -> dict:
+    """Expand a {subtree_name: assignment} prefix into a full per-leaf tree."""
+    return {
+        name: jax.tree.map(lambda _: prefix_assignments[name], subtree)
+        for name, subtree in tree.items()
+    }

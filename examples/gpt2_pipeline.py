@@ -1,0 +1,98 @@
+"""Judged config 5: GPT-2 pipeline-parallel LM training (GPipe microbatch
+schedule over the ``pipe`` mesh axis, composed with data parallelism).
+
+No reference equivalent exists (the guide's only composition mechanism is
+PS/worker processes); see parallel/pipeline.py for the design.
+
+    # 4-stage pipeline x 2-way data parallel on 8 fake devices:
+    python examples/gpt2_pipeline.py --fake-devices 8 --pipe 4 --layers 12
+
+    # full GPT-2 124M geometry (for a real v5e-16: --pipe 4, data fills rest)
+    python examples/gpt2_pipeline.py --full-gpt2 --pipe 4
+"""
+
+import argparse
+import logging
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--pipe", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=1024)
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--microbatch-size", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--full-gpt2", action="store_true",
+                    help="use the real GPT-2 124M geometry")
+    ap.add_argument("--fake-devices", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.fake_devices:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.fake_devices:
+        # env + config both needed: the axon plugin re-asserts during import
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.fake_devices)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_guide_tpu.core.dist import initialize
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, axis_sizes, build_mesh
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        TransformerConfig,
+        gpt2_124m,
+    )
+    from distributed_tensorflow_guide_tpu.parallel.pipeline import PipelinedLM
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s", force=True)
+    initialize()
+
+    mesh = build_mesh(MeshSpec(data=-1, pipe=args.pipe))
+    sizes = axis_sizes(mesh)
+    if args.full_gpt2:
+        cfg = gpt2_124m(remat=True)
+    else:
+        cfg = TransformerConfig(
+            vocab_size=args.vocab, num_layers=args.layers,
+            num_heads=args.heads, d_model=args.d_model,
+            d_ff=4 * args.d_model, max_len=args.seq_len, causal=True,
+            dtype=jnp.float32,
+        )
+    pp = PipelinedLM(mesh, cfg, num_microbatches=args.microbatches)
+    params = pp.init_params(jax.random.PRNGKey(0))
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    tx = optax.adam(args.lr)
+    opt_state = pp.init_opt_state(tx, params)
+    step = pp.make_train_step(tx, params)
+
+    per_shard = args.microbatches * args.microbatch_size
+    rng = np.random.RandomState(0)
+    tokens_fixed = rng.randint(
+        0, cfg.vocab_size, (per_shard * sizes["data"], cfg.max_len)
+    ).astype(np.int32)
+    bubble = (sizes["pipe"] - 1) / (args.microbatches + sizes["pipe"] - 1)
+    for i in range(args.steps):
+        opt_state, params, m = step(opt_state, params, tokens_fixed)
+        if i % 5 == 0:
+            print(f"step {i}: loss={float(m['loss']):.4f}")
+    print(f"done: {n_params/1e6:.1f}M params over {sizes['pipe']} stages x "
+          f"{sizes['data']} data shards; GPipe bubble fraction "
+          f"{bubble:.2f} ({args.microbatches} microbatches)")
+
+
+if __name__ == "__main__":
+    main()

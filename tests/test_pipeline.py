@@ -1,0 +1,110 @@
+"""Config-5 coverage: GPipe pipeline parallelism over the pipe mesh axis.
+
+The load-bearing test is parity: the pipelined step must produce the SAME
+loss and gradients as an unpipelined run of the identical stacked-layer
+model (pipelining is an execution schedule, not a different algorithm)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import lax
+
+from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+from distributed_tensorflow_guide_tpu.models.transformer import (
+    Block,
+    TransformerConfig,
+)
+from distributed_tensorflow_guide_tpu.parallel.pipeline import PipelinedLM
+
+CFG = TransformerConfig(
+    vocab_size=64, num_layers=4, num_heads=2, d_model=32, d_ff=64,
+    max_len=16, causal=True, dtype=jnp.float32,
+)
+
+
+def _tokens(batch, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG.vocab_size, (batch, CFG.max_len)).astype(np.int32)
+
+
+def _reference_loss(pp, params, tokens):
+    """Unpipelined forward with the same stacked params."""
+    x = pp.embedder.apply({"params": params["embed"]}, tokens)
+    flat = jax.tree.map(
+        lambda s: s.reshape(-1, *s.shape[2:]), params["stages"]
+    )
+
+    def body(h, layer_params):
+        return pp.block.apply({"params": layer_params}, h), None
+
+    x, _ = lax.scan(body, x, flat)
+    logits = pp.head.apply({"params": params["head"]}, x)
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    ll = jnp.take_along_axis(logp, tokens[:, 1:][..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+@pytest.mark.parametrize("n_pipe,n_data", [(4, 1), (2, 2)])
+def test_pipeline_matches_unpipelined(n_pipe, n_data):
+    mesh = build_mesh(MeshSpec(data=n_data, pipe=n_pipe, model=8 // (n_pipe * n_data)))
+    M = 4  # microbatches
+    pp = PipelinedLM(mesh, CFG, num_microbatches=M)
+    params = pp.init_params(jax.random.PRNGKey(0))
+    tx = optax.sgd(0.1)
+    opt_state = pp.init_opt_state(tx, params)
+    step = pp.make_train_step(tx, params, donate=False)
+
+    tokens = _tokens(8 * n_data)  # per data shard: 8 = M * mb(2)
+    opt2, params2, m = step(opt_state, params, tokens)
+
+    ref_loss = float(_reference_loss(pp, jax.tree.map(np.asarray, params),
+                                     jnp.asarray(tokens)))
+    np.testing.assert_allclose(float(m["loss"]), ref_loss, rtol=1e-5)
+
+    # gradient parity: compare updated params against reference SGD step
+    g_ref = jax.grad(
+        lambda p: _reference_loss(pp, p, jnp.asarray(tokens))
+    )(jax.tree.map(np.asarray, params))
+    for (path, a), (_, g) in zip(
+        jax.tree_util.tree_flatten_with_path(jax.tree.map(np.asarray, params2))[0],
+        jax.tree_util.tree_flatten_with_path(g_ref)[0],
+    ):
+        orig = jax.tree_util.tree_flatten_with_path(
+            jax.tree.map(np.asarray, params)
+        )[0]
+        expected = dict(orig)[path] - 0.1 * np.asarray(g)
+        np.testing.assert_allclose(np.asarray(a), expected, rtol=1e-4,
+                                   atol=1e-6, err_msg=str(path))
+
+
+def test_pipeline_training_learns():
+    mesh = build_mesh(MeshSpec(data=2, pipe=4, model=1))
+    pp = PipelinedLM(mesh, CFG, num_microbatches=4)
+    params = pp.init_params(jax.random.PRNGKey(1))
+    tx = optax.adam(3e-3)
+    opt_state = pp.init_opt_state(tx, params)
+    step = pp.make_train_step(tx, params, donate=False)
+    tokens = _tokens(16, seed=1)  # fixed batch -> memorize
+    losses = []
+    for _ in range(15):
+        opt_state, params, m = step(opt_state, params, tokens)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_layers_must_divide_stages():
+    mesh = build_mesh(MeshSpec(data=1, pipe=8, model=1))
+    cfg = TransformerConfig(num_layers=4)
+    with pytest.raises(ValueError):
+        PipelinedLM(mesh, cfg, num_microbatches=2)
+
+
+def test_stage_params_actually_sharded():
+    mesh = build_mesh(MeshSpec(data=1, pipe=4, model=2))
+    pp = PipelinedLM(mesh, CFG, num_microbatches=2)
+    params = pp.init_params(jax.random.PRNGKey(0))
+    leaf = jax.tree.leaves(params["stages"])[0]
+    assert leaf.shape[0] == 4
+    assert leaf.addressable_shards[0].data.shape[0] == 1  # one stage per device

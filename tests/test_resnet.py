@@ -1,0 +1,108 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+from distributed_tensorflow_guide_tpu.models.resnet import (
+    ResNet,
+    ResNet50,
+    make_loss_fn,
+)
+from distributed_tensorflow_guide_tpu.parallel.data_parallel import DataParallel
+from distributed_tensorflow_guide_tpu.train.state import TrainStateWithStats
+
+
+def _tiny():
+    return ResNet(
+        stage_sizes=(1, 1, 1, 1), num_classes=10, num_filters=8,
+        dtype=jnp.float32, small_inputs=True,
+    )
+
+
+def _batch(n=16, size=32, classes=10, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "image": rng.randn(n, size, size, 3).astype(np.float32),
+        "label": rng.randint(0, classes, n).astype(np.int32),
+    }
+
+
+def test_resnet50_param_count():
+    model = ResNet50(num_classes=1000, dtype=jnp.float32)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)),
+                           train=False)
+    )
+    n_params = sum(np.prod(p.shape) for p in jax.tree.leaves(variables["params"]))
+    assert 25.5e6 < n_params < 25.7e6, n_params  # canonical ResNet-50 ≈ 25.6M
+
+
+def test_forward_shapes_and_dtype():
+    model = _tiny()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)),
+                           train=False)
+    logits = model.apply(variables, jnp.ones((4, 32, 32, 3)), train=False)
+    assert logits.shape == (4, 10) and logits.dtype == jnp.float32
+
+
+def test_dp_train_step_with_stats_updates_and_learns(mesh8):
+    model = _tiny()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    dp = DataParallel(mesh8)
+    state = dp.replicate(
+        TrainStateWithStats.create(
+            apply_fn=model.apply, params=variables["params"],
+            tx=optax.sgd(0.05, momentum=0.9),
+            model_state={"batch_stats": variables["batch_stats"]},
+        )
+    )
+    step = dp.make_train_step_with_stats(make_loss_fn(model), donate=False)
+    stats0 = jax.tree.map(np.asarray, state.model_state)
+    losses = []
+    for i in range(8):
+        state, m = step(state, dp.shard_batch(_batch(seed=0)))  # fixed batch
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+    # BN running stats moved
+    moved = any(
+        not np.allclose(a, b)
+        for a, b in zip(
+            jax.tree.leaves(stats0),
+            jax.tree.leaves(jax.tree.map(np.asarray, state.model_state)),
+        )
+    )
+    assert moved
+
+
+def test_weight_decay_increases_loss():
+    model = _tiny()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    b = _batch()
+    l0, _ = make_loss_fn(model, weight_decay=0.0)(
+        variables["params"], {"batch_stats": variables["batch_stats"]}, b
+    )
+    l1, _ = make_loss_fn(model, weight_decay=1e-2)(
+        variables["params"], {"batch_stats": variables["batch_stats"]}, b
+    )
+    assert float(l1) > float(l0)
+
+
+def test_graft_entry_contract():
+    """The driver contract: entry() returns a jittable fn + args (abstract
+    eval only here — full compile happens on the driver's chip)."""
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.eval_shape(fn, *args)
+    assert out.shape == (4, 1024, 50304)  # GPT-2 124M LM logits
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_8():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)

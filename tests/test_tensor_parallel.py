@@ -1,0 +1,127 @@
+"""Config-3 coverage: param-sharded (tensor-parallel) training via pjit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax.training import train_state
+
+from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+from distributed_tensorflow_guide_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    make_cls_loss_fn,
+    make_lm_loss_fn,
+)
+from distributed_tensorflow_guide_tpu.parallel.tensor import TensorParallel
+
+CFG = TransformerConfig(
+    vocab_size=128, num_layers=2, num_heads=4, d_model=64, d_ff=128,
+    max_len=32, causal=False, dtype=jnp.float32, num_classes=2,
+)
+
+
+def _batch(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "tokens": rng.randint(0, CFG.vocab_size, (n, CFG.max_len)).astype(np.int32),
+        "label": rng.randint(0, 2, n).astype(np.int32),
+    }
+
+
+def _tp():
+    mesh = build_mesh(MeshSpec(data=2, model=4))
+    return TensorParallel(mesh), mesh
+
+
+def test_params_actually_sharded_over_model_axis():
+    tp, mesh = _tp()
+    model = Transformer(CFG)
+    params, shardings = tp.init_params(
+        model, jax.random.PRNGKey(0), jnp.zeros((1, CFG.max_len), jnp.int32)
+    )
+    up = params["block_0"]["mlp"]["up"]["kernel"]
+    spec = up.sharding.spec
+    assert "model" in tuple(spec), spec  # d_ff dim sharded
+    # each device holds 1/4 of the mlp kernel along d_ff
+    shard_shape = up.addressable_shards[0].data.shape
+    assert shard_shape == (CFG.d_model, CFG.d_ff // 4)
+
+
+def test_tp_training_step_runs_and_learns():
+    tp, mesh = _tp()
+    model = Transformer(CFG)
+    params, shardings = tp.init_params(
+        model, jax.random.PRNGKey(0), jnp.zeros((1, CFG.max_len), jnp.int32)
+    )
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.adam(1e-3)
+    )
+    st_shard = tp.state_shardings(state, shardings)
+    state = jax.device_put(state, st_shard)
+    step = tp.make_train_step(make_cls_loss_fn(model), st_shard, donate=False)
+    losses = []
+    for i in range(10):
+        state, m = step(state, _batch(seed=0))  # fixed batch -> memorize
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.5, losses
+    # optimizer moments follow the param sharding
+    mu = state.opt_state[0].mu["block_0"]["mlp"]["up"]["kernel"]
+    assert "model" in tuple(mu.sharding.spec)
+
+
+def test_tp_matches_single_device():
+    """Param-sharded training == unsharded training (GSPMD is semantics-
+    preserving) — the R2-as-control structure applied to TP."""
+    tp, mesh = _tp()
+    model = Transformer(CFG)
+    sample = jnp.zeros((1, CFG.max_len), jnp.int32)
+    params, shardings = tp.init_params(model, jax.random.PRNGKey(0), sample)
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.1)
+    )
+    st_shard = tp.state_shardings(state, shardings)
+    state_tp = jax.device_put(state, st_shard)
+    step = tp.make_train_step(make_cls_loss_fn(model), st_shard, donate=False)
+
+    # single-device control from the same initial values
+    params_1d = jax.device_put(jax.tree.map(np.asarray, params))
+    state_1d = train_state.TrainState.create(
+        apply_fn=model.apply, params=params_1d, tx=optax.sgd(0.1)
+    )
+    loss_fn = make_cls_loss_fn(model)
+
+    @jax.jit
+    def step_1d(s, b):
+        (l, mets), g = jax.value_and_grad(loss_fn, has_aux=True)(s.params, b)
+        return s.apply_gradients(grads=g), {"loss": l, **mets}
+
+    for i in range(3):
+        b = _batch(seed=i)
+        state_tp, m_tp = step(state_tp, b)
+        state_1d, m_1d = step_1d(state_1d, b)
+    np.testing.assert_allclose(
+        float(m_tp["loss"]), float(m_1d["loss"]), rtol=1e-4
+    )
+
+
+def test_lm_head_variant_runs():
+    cfg = TransformerConfig(
+        vocab_size=128, num_layers=1, num_heads=4, d_model=32, d_ff=64,
+        max_len=16, causal=True, dtype=jnp.float32,
+    )
+    tp, mesh = _tp()
+    model = Transformer(cfg)
+    params, shardings = tp.init_params(
+        model, jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_len), jnp.int32)
+    )
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.adam(1e-3)
+    )
+    st = tp.state_shardings(state, shardings)
+    state = jax.device_put(state, st)
+    step = tp.make_train_step(make_lm_loss_fn(model), st, donate=False)
+    rng = np.random.RandomState(0)
+    b = {"tokens": rng.randint(0, 128, (8, 16)).astype(np.int32)}
+    state, m = step(state, b)
+    assert np.isfinite(float(m["loss"])) and float(m["perplexity"]) > 1
