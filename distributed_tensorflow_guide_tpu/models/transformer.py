@@ -42,6 +42,19 @@ class TransformerConfig:
     dtype: Dtype = jnp.bfloat16
     remat: bool = False
     num_classes: int | None = None  # set → classification head (BERT/GLUE)
+    # "dense"  — XLA softmax attention (materializes (S, S) scores). GSPMD
+    #            partitions it under pjit, so it composes with TP sharding.
+    # "flash"  — fused Pallas kernel (ops/flash_attention.py); falls back to
+    #            the pure-XLA blockwise path on unsupported shapes. Use inside
+    #            shard_map strategies (DP/PP/SP — per-device local arrays);
+    #            under pjit/TP GSPMD cannot partition the custom call.
+    attn_impl: str = "dense"
+
+    def __post_init__(self):
+        if self.attn_impl not in ("dense", "flash"):
+            raise ValueError(
+                f"attn_impl must be 'dense' or 'flash', got {self.attn_impl!r}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -94,15 +107,26 @@ class MultiHeadAttention(nn.Module):
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
 
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(
-            cfg.dtype
-        )
-        if cfg.causal:
-            s = x.shape[1]
-            mask = jnp.tril(jnp.ones((s, s), bool))
-            scores = jnp.where(mask[None, None], scores, jnp.finfo(cfg.dtype).min)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        if cfg.attn_impl == "flash":
+            from distributed_tensorflow_guide_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+
+            out = flash_attention(q, k, v, causal=cfg.causal)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(
+                cfg.dtype
+            )
+            if cfg.causal:
+                s = x.shape[1]
+                mask = jnp.tril(jnp.ones((s, s), bool))
+                scores = jnp.where(
+                    mask[None, None], scores, jnp.finfo(cfg.dtype).min
+                )
+            probs = jax.nn.softmax(
+                scores.astype(jnp.float32), axis=-1
+            ).astype(cfg.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         out = nn.DenseGeneral(
             cfg.d_model,
             axis=(-2, -1),

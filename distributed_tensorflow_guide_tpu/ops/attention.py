@@ -78,6 +78,13 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     """
     b, s, hn, d = q.shape
     scale = 1.0 / (d ** 0.5)
+    if s % block_size:
+        # dynamic_slice needs equal blocks: use the largest divisor of s that
+        # fits, keeping O(S*block) memory; only a near-prime s (no divisor
+        # >= 16) degrades to one full-width block.
+        block_size = next(
+            (b for b in range(min(block_size, s), 15, -1) if s % b == 0), s
+        )
     n_blocks = -(-s // block_size)
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
     m, l, o = init_carry(q.shape)

@@ -1,0 +1,392 @@
+"""Fused flash attention as a Pallas TPU kernel — the framework's "native
+code" tier (SURVEY.md §2b/§5: the reference's native machinery is the TF
+C++/CUDA runtime; on TPU the idiomatic native tier is a Mosaic kernel).
+
+Forward and backward are hand-written kernels (FlashAttention, Dao et al.
+2022; same online-softmax algebra as ops/attention.py, which is the
+pure-XLA reference implementation these kernels are tested against):
+
+* forward: one pass over KV blocks per Q block, carrying the running
+  row-max ``m`` and normalizer ``l`` in VMEM scratch; O(S) memory, no
+  (S, S) score matrix ever hits HBM. Saves per-row logsumexp for backward.
+* backward: recomputes probabilities from the saved logsumexp (no stored
+  attention matrix) in two kernels — one accumulating dQ over KV blocks,
+  one accumulating dK/dV over Q blocks — the standard flash backward split
+  that keeps every accumulation local to one grid cell's scratch.
+
+Layout: public API takes (B, S, H, D) like the rest of the package and
+transposes to (B, H, S, D) for the kernel so the (S, D) tiles are MXU-shaped.
+Head dim is zero-padded to a lane multiple (128); zero columns are exact
+no-ops through q·kᵀ and the p·v contraction, and are sliced off on return.
+
+On CPU (tests, dryrun) the same kernels run via ``interpret=True``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports only resolve fully on TPU builds; interpret works anyway
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+NEG_INF = -1e30
+LANE = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _vmem_spec(block_shape=None, index_map=None):
+    kw = {}
+    if _VMEM is not None:
+        kw["memory_space"] = _VMEM
+    return pl.BlockSpec(block_shape, index_map, **kw)
+
+
+def _vmem_scratch(shape, dtype):
+    if _VMEM is not None:
+        return _VMEM(shape, dtype)
+    from jax.experimental.pallas import MemorySpace
+
+    return MemorySpace.ANY(shape, dtype)  # pragma: no cover
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool, blk_q: int, blk_k: int):
+    i, j = pl.program_id(2), pl.program_id(3)
+    n_kv = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: KV blocks strictly above the diagonal contribute nothing.
+    should_run = True
+    if causal:
+        should_run = (j * blk_k) <= (i * blk_q + blk_q - 1)
+
+    @pl.when(should_run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)  # (blk_q, Dp)
+        k = k_ref[0, 0].astype(jnp.float32)  # (blk_k, Dp)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (blk_q, blk_k)
+        if causal:
+            q_pos = i * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0
+            )
+            kv_pos = j * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1
+            )
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        m_prev = m_scr[:, :1]  # (blk_q, 1)
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == n_kv - 1)
+    def _():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        lse = m_scr[:, :1] + jnp.log(safe_l)
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _fwd_call(q, k, v, *, scale, causal, blk_q, blk_k):
+    b, h, s, dp = q.shape
+    n_q, n_kv = s // blk_q, s // blk_k
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, n_q, n_kv),
+        in_specs=[
+            _vmem_spec((1, 1, blk_q, dp), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, blk_k, dp), lambda b, h, i, j: (b, h, j, 0)),
+            _vmem_spec((1, 1, blk_k, dp), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, 1, blk_q, dp), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, blk_q, LANE), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, dp), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, LANE), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem_scratch((blk_q, LANE), jnp.float32),
+            _vmem_scratch((blk_q, LANE), jnp.float32),
+            _vmem_scratch((blk_q, dp), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse[..., 0]  # lse: (B, H, S)
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale: float, causal: bool, blk_q: int,
+                   blk_k: int):
+    i, j = pl.program_id(2), pl.program_id(3)
+    n_kv = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    should_run = True
+    if causal:
+        should_run = (j * blk_k) <= (i * blk_q + blk_q - 1)
+
+    @pl.when(should_run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]  # (blk_q, 1)
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = i * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0
+            )
+            kv_pos = j * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1
+            )
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # rows with lse=-inf can't occur (see fwd)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (blk_q, blk_k)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_kv - 1)
+    def _():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                    causal: bool, blk_q: int, blk_k: int):
+    # grid: (b, h, kv_block j, q_block i) — inner loop over Q blocks
+    j, i = pl.program_id(2), pl.program_id(3)
+    n_q = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    should_run = True
+    if causal:
+        should_run = (j * blk_k) <= (i * blk_q + blk_q - 1)
+
+    @pl.when(should_run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (blk_q, blk_k)
+        if causal:
+            q_pos = i * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0
+            )
+            kv_pos = j * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1
+            )
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # (blk_q, blk_k)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # pᵀ·dO → (blk_k, Dp)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale  # (blk_q, blk_k)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # dsᵀ·q → (blk_k, Dp)
+
+    @pl.when(i == n_q - 1)
+    def _():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, do, lse, delta, *, scale, causal, blk_q, blk_k):
+    b, h, s, dp = q.shape
+    n_q, n_kv = s // blk_q, s // blk_k
+    lse_b = jnp.broadcast_to(lse[..., None], (b, h, s, LANE))
+    delta_b = jnp.broadcast_to(delta[..., None], (b, h, s, LANE))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, blk_q=blk_q,
+            blk_k=blk_k,
+        ),
+        grid=(b, h, n_q, n_kv),
+        in_specs=[
+            _vmem_spec((1, 1, blk_q, dp), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, blk_k, dp), lambda b, h, i, j: (b, h, j, 0)),
+            _vmem_spec((1, 1, blk_k, dp), lambda b, h, i, j: (b, h, j, 0)),
+            _vmem_spec((1, 1, blk_q, dp), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, blk_q, LANE), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, blk_q, LANE), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_specs=_vmem_spec(
+            (1, 1, blk_q, dp), lambda b, h, i, j: (b, h, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, dp), q.dtype),
+        scratch_shapes=[_vmem_scratch((blk_q, dp), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse_b, delta_b)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, blk_q=blk_q,
+            blk_k=blk_k,
+        ),
+        grid=(b, h, n_kv, n_q),
+        in_specs=[
+            _vmem_spec((1, 1, blk_q, dp), lambda b, h, j, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, blk_k, dp), lambda b, h, j, i: (b, h, j, 0)),
+            _vmem_spec((1, 1, blk_k, dp), lambda b, h, j, i: (b, h, j, 0)),
+            _vmem_spec((1, 1, blk_q, dp), lambda b, h, j, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, blk_q, LANE), lambda b, h, j, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, blk_q, LANE), lambda b, h, j, i: (b, h, i, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, 1, blk_k, dp), lambda b, h, j, i: (b, h, j, 0)),
+            _vmem_spec((1, 1, blk_k, dp), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, dp), k.dtype),
+            jax.ShapeDtypeStruct((b, h, s, dp), v.dtype),
+        ],
+        scratch_shapes=[
+            _vmem_scratch((blk_k, dp), jnp.float32),
+            _vmem_scratch((blk_k, dp), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse_b, delta_b)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public API with custom VJP
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, blk_q, blk_k):
+    out, _ = _fwd_call(q, k, v, scale=scale, causal=causal, blk_q=blk_q,
+                       blk_k=blk_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, blk_q, blk_k):
+    out, lse = _fwd_call(q, k, v, scale=scale, causal=causal, blk_q=blk_q,
+                         blk_k=blk_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, blk_q, blk_k, res, g):
+    q, k, v, out, lse = res
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    dq, dk, dv = _bwd_call(
+        q, k, v, g, lse, delta, scale=scale, causal=causal, blk_q=blk_q,
+        blk_k=blk_k,
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def supported(s: int, d: int, blk_q: int = 128, blk_k: int = 128) -> bool:
+    """Shapes the fused kernel handles; callers fall back to the pure-XLA
+    blockwise path otherwise."""
+    return s % blk_q == 0 and s % blk_k == 0 and s >= max(blk_q, blk_k)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, blk_q: int = 128,
+                    blk_k: int = 128):
+    """Fused attention, public layout (B, S, H, D) → (B, S, H, D).
+
+    Softmax scale is 1/sqrt(D) over the *logical* head dim (padding lanes
+    excluded). Differentiable via hand-written backward kernels.
+    """
+    b, s, hn, d = q.shape
+    if not supported(s, d, blk_q, blk_k):
+        from distributed_tensorflow_guide_tpu.ops.attention import (
+            blockwise_attention,
+        )
+
+        return blockwise_attention(q, k, v, causal=causal)
+    scale = 1.0 / (d ** 0.5)
+    dp = -(-d // LANE) * LANE
+
+    def to_kernel(x):
+        x = jnp.transpose(x, (0, 2, 1, 3))  # (B, H, S, D)
+        if dp != d:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, dp - d)))
+        return x
+
+    out = _flash(to_kernel(q), to_kernel(k), to_kernel(v), scale, causal,
+                 blk_q, blk_k)
+    out = jnp.transpose(out, (0, 2, 1, 3))
+    return out[..., :d]
