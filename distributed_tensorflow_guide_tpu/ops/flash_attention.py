@@ -62,6 +62,39 @@ def _vmem_scratch(shape, dtype):
 
 
 # --------------------------------------------------------------------------
+# shared kernel pieces
+# --------------------------------------------------------------------------
+
+
+def _causal_block_live(i, j, blk_q: int, blk_k: int):
+    """False iff KV block j lies strictly above Q block i's diagonal."""
+    return (j * blk_k) <= (i * blk_q + blk_q - 1)
+
+
+def _masked_scores(q_ref, k_ref, i, j, *, scale, causal, blk_q, blk_k):
+    """scale·q·kᵀ for one (Q-block i, KV-block j) pair, causal-masked.
+
+    The single definition shared by forward and both backward kernels so the
+    recomputed probabilities can never drift from the forward pass.
+    """
+    q = q_ref[0, 0].astype(jnp.float32)  # (blk_q, Dp)
+    k = k_ref[0, 0].astype(jnp.float32)  # (blk_k, Dp)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # (blk_q, blk_k)
+    if causal:
+        q_pos = i * blk_q + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 0
+        )
+        kv_pos = j * blk_k + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 1
+        )
+        s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+    return s
+
+
+# --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
 
@@ -80,25 +113,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     # Causal: KV blocks strictly above the diagonal contribute nothing.
     should_run = True
     if causal:
-        should_run = (j * blk_k) <= (i * blk_q + blk_q - 1)
+        should_run = _causal_block_live(i, j, blk_q, blk_k)
 
     @pl.when(should_run)
     def _():
-        q = q_ref[0, 0].astype(jnp.float32)  # (blk_q, Dp)
-        k = k_ref[0, 0].astype(jnp.float32)  # (blk_k, Dp)
         v = v_ref[0, 0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # (blk_q, blk_k)
-        if causal:
-            q_pos = i * blk_q + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 0
-            )
-            kv_pos = j * blk_k + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 1
-            )
-            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        s = _masked_scores(q_ref, k_ref, i, j, scale=scale, causal=causal,
+                           blk_q=blk_q, blk_k=blk_k)
         m_prev = m_scr[:, :1]  # (blk_q, 1)
         l_prev = l_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -152,7 +173,13 @@ def _fwd_call(q, k, v, *, scale, causal, blk_q, blk_k):
         ],
         interpret=_interpret(),
     )(q, k, v)
-    return out, lse[..., 0]  # lse: (B, H, S)
+    # lse stays lane-broadcast at (B, H, S, LANE): the (blk_q,)→(blk_q, 1)
+    # sublane relayout a compact (B, H, S) residual would force on every
+    # backward read is what Mosaic handles worst; jax's own TPU flash kernel
+    # makes the same trade (pallas/ops/tpu/flash_attention.py stores l/m at
+    # MIN_BLOCK_SIZE=128 lanes). Backward consumes it directly — no
+    # slice-then-rebroadcast round trip through HBM.
+    return out, lse
 
 
 # --------------------------------------------------------------------------
@@ -172,28 +199,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     should_run = True
     if causal:
-        should_run = (j * blk_k) <= (i * blk_q + blk_q - 1)
+        should_run = _causal_block_live(i, j, blk_q, blk_k)
 
     @pl.when(should_run)
     def _():
-        q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, :1]  # (blk_q, 1)
         delta = delta_ref[0, 0][:, :1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if causal:
-            q_pos = i * blk_q + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 0
-            )
-            kv_pos = j * blk_k + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 1
-            )
-            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        s = _masked_scores(q_ref, k_ref, i, j, scale=scale, causal=causal,
+                           blk_q=blk_q, blk_k=blk_k)
         p = jnp.exp(s - lse)  # rows with lse=-inf can't occur (see fwd)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -224,28 +240,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     should_run = True
     if causal:
-        should_run = (j * blk_k) <= (i * blk_q + blk_q - 1)
+        should_run = _causal_block_live(i, j, blk_q, blk_k)
 
     @pl.when(should_run)
     def _():
         q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, :1]
         delta = delta_ref[0, 0][:, :1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # (blk_q, blk_k)
-        if causal:
-            q_pos = i * blk_q + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 0
-            )
-            kv_pos = j * blk_k + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 1
-            )
-            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        s = _masked_scores(q_ref, k_ref, i, j, scale=scale, causal=causal,
+                           blk_q=blk_q, blk_k=blk_k)
         p = jnp.exp(s - lse)  # (blk_q, blk_k)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -268,9 +273,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_call(q, k, v, do, lse, delta, *, scale, causal, blk_q, blk_k):
+    """lse arrives lane-broadcast (B, H, S, LANE) straight from forward;
+    delta is (B, H, S) and broadcast once here."""
     b, h, s, dp = q.shape
     n_q, n_kv = s // blk_q, s // blk_k
-    lse_b = jnp.broadcast_to(lse[..., None], (b, h, s, LANE))
+    lse_b = lse
     delta_b = jnp.broadcast_to(delta[..., None], (b, h, s, LANE))
 
     dq = pl.pallas_call(

@@ -1,0 +1,84 @@
+"""Elastic recovery: checkpoint-restart supervision around the train loop.
+
+The reference has *no* failure handling (SURVEY.md §5): ``run.sh`` spawns
+processes with no supervision, and a dead PS hangs all workers on gRPC;
+``MonitoredTrainingSession`` offers restart-from-checkpoint only if an
+external agent restarts the process.
+
+TPU-native recovery model: the SPMD program is all-or-nothing (a lost host
+kills the step everywhere — there is no degraded PS mode to limp along in),
+so recovery = restore-latest-checkpoint + replay. ``run_with_recovery``
+supervises in-process: on a transient failure it restores the newest Orbax
+checkpoint, rebuilds the loop at that step, and continues, up to
+``max_restarts``. Crash-only semantics: anything the loop did after its last
+checkpoint is discarded, which is exactly what makes the result equal to an
+uninterrupted run (tested in tests/test_elastic.py).
+
+Cross-process failure *detection* lives one level down:
+``jax.distributed.initialize`` heartbeats peers via the coordinator, and the
+``runtime.multiprocess`` harness supervises at the OS level (exit codes,
+timeouts, kill-the-rest) — see its fault-injection tests.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterable, Sequence
+
+from distributed_tensorflow_guide_tpu.train.checkpoint import (
+    Checkpointer,
+    CheckpointHook,
+)
+from distributed_tensorflow_guide_tpu.train.hooks import Hook
+from distributed_tensorflow_guide_tpu.train.loop import StepFn, TrainLoop
+
+log = logging.getLogger("dtg.train")
+
+
+class TooManyRestarts(RuntimeError):
+    pass
+
+
+def run_with_recovery(
+    step_fn: StepFn,
+    init_state: Any,
+    make_data: Callable[[int], Iterable],
+    checkpointer: Checkpointer,
+    *,
+    hooks: Sequence[Hook] = (),
+    checkpoint_every: int = 100,
+    max_restarts: int = 3,
+    recoverable: tuple[type[BaseException], ...] = (RuntimeError,),
+) -> Any:
+    """Supervised training: run → crash → restore → resume, bounded.
+
+    ``make_data(start_step)`` must yield the batch stream for steps
+    ``start_step, start_step+1, ...`` — data position is part of resume
+    state, exactly like the reference's global_step-keyed input pipelines.
+    Returns the final train state.
+    """
+    restarts = 0
+    while True:
+        start = checkpointer.latest_step() or 0
+        state = (
+            checkpointer.restore(init_state) if start else init_state
+        )
+        loop = TrainLoop(
+            step_fn,
+            state,
+            make_data(start),
+            hooks=[CheckpointHook(checkpointer, checkpoint_every), *hooks],
+            start_step=start,
+        )
+        try:
+            return loop.run()
+        except recoverable as e:
+            restarts += 1
+            if restarts > max_restarts:
+                raise TooManyRestarts(
+                    f"gave up after {max_restarts} restarts: {e}"
+                ) from e
+            log.warning(
+                "step %d failed (%s); restart %d/%d from checkpoint",
+                loop.step, e, restarts, max_restarts,
+            )

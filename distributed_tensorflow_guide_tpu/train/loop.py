@@ -56,21 +56,26 @@ class TrainLoop:
         return self._stop
 
     def run(self) -> Any:
-        """Run to completion; returns the final state."""
+        """Run to completion; returns the final state.
+
+        ``end`` hooks fire only on *clean* completion. On a crash the loop
+        re-raises without finalizing: with async dispatch, ``self.state`` may
+        already hold poisoned arrays from the failed step, and an end-of-run
+        checkpoint of it would overwrite the last good resume point
+        (train/elastic.py restores strictly pre-crash checkpoints instead).
+        """
         for h in self.hooks:
             h.begin(self)
         it: Iterator = iter(self.data)
-        try:
-            while not self._stop:
-                try:
-                    batch = next(it)
-                except StopIteration:
-                    break
-                self.state, metrics = self.step_fn(self.state, batch)
-                for h in self.hooks:
-                    h.after_step(self.step, metrics)
-                self.step += 1
-        finally:
+        while not self._stop:
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            self.state, metrics = self.step_fn(self.state, batch)
             for h in self.hooks:
-                h.end(self.step)
+                h.after_step(self.step, metrics)
+            self.step += 1
+        for h in self.hooks:
+            h.end(self.step)
         return self.state

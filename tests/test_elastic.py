@@ -1,0 +1,115 @@
+"""Elastic recovery: crash mid-training, restore from checkpoint, finish —
+and end bit-identical to an uninterrupted run (SURVEY.md §4 parity rule
+applied to the failure path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_guide_tpu.train.checkpoint import Checkpointer
+from distributed_tensorflow_guide_tpu.train.elastic import (
+    TooManyRestarts,
+    run_with_recovery,
+)
+from distributed_tensorflow_guide_tpu.train.hooks import StopAtStepHook
+
+TOTAL_STEPS = 20
+CKPT_EVERY = 5
+
+
+def _step_fn(state, batch):
+    # toy GD on sum-of-squares; deterministic in (state, batch)
+    params = state["params"]
+    grad = 2 * params + batch
+    new = {"params": params - 0.01 * grad}
+    return new, {"loss": jnp.sum(params ** 2)}
+
+
+def _make_data(start_step):
+    # deterministic stream keyed by step — resume must not replay
+    return (jnp.full((4,), float(s)) for s in range(start_step, 10_000))
+
+
+def _init_state():
+    return {"params": jnp.ones((4,))}
+
+
+def _run(crash_at=None, tmpdir=None, max_restarts=3):
+    crashed = []
+
+    def step(state, batch):
+        # host-side fault injection: raise exactly once at `crash_at`
+        if crash_at is not None and not crashed:
+            # batch value encodes the step (see _make_data)
+            if int(batch[0]) == crash_at:
+                crashed.append(True)
+                raise RuntimeError("injected crash")
+        return _step_fn(state, batch)
+
+    ckpt = Checkpointer(tmpdir, max_to_keep=2)
+    try:
+        return run_with_recovery(
+            step,
+            _init_state(),
+            _make_data,
+            ckpt,
+            hooks=[StopAtStepHook(TOTAL_STEPS)],
+            checkpoint_every=CKPT_EVERY,
+            max_restarts=max_restarts,
+        )
+    finally:
+        ckpt.close()
+
+
+def test_crash_resume_matches_uninterrupted(tmp_path):
+    clean = _run(tmpdir=tmp_path / "clean")
+    crashed = _run(crash_at=12, tmpdir=tmp_path / "crashed")
+    np.testing.assert_array_equal(
+        np.asarray(clean["params"]), np.asarray(crashed["params"])
+    )
+
+
+def test_restart_budget_enforced(tmp_path):
+    def always_fail(state, batch):
+        raise RuntimeError("permanent failure")
+
+    ckpt = Checkpointer(tmp_path / "fail", max_to_keep=1)
+    try:
+        with pytest.raises(TooManyRestarts):
+            run_with_recovery(
+                always_fail,
+                _init_state(),
+                _make_data,
+                ckpt,
+                hooks=[StopAtStepHook(TOTAL_STEPS)],
+                checkpoint_every=CKPT_EVERY,
+                max_restarts=2,
+            )
+    finally:
+        ckpt.close()
+
+
+def test_resume_from_existing_checkpoint_dir(tmp_path):
+    # run to step 20, then extend the same dir to 30 — warm-start resume
+    d = tmp_path / "extend"
+    _run(tmpdir=d)
+    ckpt = Checkpointer(d, max_to_keep=2)
+    try:
+        final = run_with_recovery(
+            _step_fn,
+            _init_state(),
+            _make_data,
+            ckpt,
+            hooks=[StopAtStepHook(30)],
+            checkpoint_every=CKPT_EVERY,
+        )
+    finally:
+        ckpt.close()
+    # oracle: 30 uninterrupted steps
+    state = _init_state()
+    for s, batch in zip(range(30), _make_data(0)):
+        state, _ = _step_fn(state, batch)
+    np.testing.assert_allclose(
+        np.asarray(final["params"]), np.asarray(state["params"]), rtol=1e-6
+    )
