@@ -1,0 +1,114 @@
+"""Unified run configuration — one dataclass + CLI, identical on every host.
+
+Reference equivalent: per-script ``tf.app.flags``/argparse with per-PROCESS
+role flags (``--job_name=ps --task_index=0``) plus ports hardcoded in each
+``run.sh``, and on the modern surface the ``TF_CONFIG`` env JSON parsed by
+TFConfigClusterResolver
+(tensorflow/python/distribute/cluster_resolver/tfconfig_cluster_resolver.py:48).
+
+SPMD inverts this (SURVEY.md §5 config row): there are no roles, so the WHOLE
+topology is ordinary config — the MeshSpec — and every host runs the same
+command line. The only per-host state is what ``jax.distributed.initialize``
+needs (core/dist.py), which stays in env vars because launchers own it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any, Sequence
+
+from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """Everything a training run needs, serializable, mesh included."""
+
+    mesh: MeshSpec = MeshSpec()
+    steps: int = 1000
+    global_batch: int = 256
+    lr: float = 1e-3
+    seed: int = 0
+    log_every: int = 100
+    ckpt_dir: str | None = None
+    ckpt_every: int = 500
+    metrics_path: str | None = None
+    tb_logdir: str | None = None
+    profile_dir: str | None = None
+    fake_devices: int = 0  # >0: force CPU with N virtual devices (tests/dev)
+
+    # -- CLI --------------------------------------------------------------
+
+    @classmethod
+    def parser(cls, parser: argparse.ArgumentParser | None = None
+               ) -> argparse.ArgumentParser:
+        p = parser or argparse.ArgumentParser(
+            description="dtg-tpu run config (SPMD: same flags on every host)")
+        for f in dataclasses.fields(cls):
+            if f.name == "mesh":
+                continue
+            # `from __future__ import annotations` makes f.type a string
+            typ = {"int": int, "float": float}.get(str(f.type), str)
+            p.add_argument(f"--{f.name.replace('_', '-')}", type=typ,
+                           default=f.default, dest=f.name)
+        for ax in dataclasses.fields(MeshSpec):
+            p.add_argument(f"--mesh-{ax.name}", type=int, default=ax.default,
+                           dest=f"mesh_{ax.name}",
+                           help=f"mesh axis {ax.name!r} size (-1 = fill)")
+        return p
+
+    @classmethod
+    def from_argv(cls, argv: Sequence[str] | None = None) -> "RunConfig":
+        ns = cls.parser().parse_args(argv)
+        mesh = MeshSpec(**{ax.name: getattr(ns, f"mesh_{ax.name}")
+                           for ax in dataclasses.fields(MeshSpec)})
+        kw = {f.name: getattr(ns, f.name) for f in dataclasses.fields(cls)
+              if f.name != "mesh"}
+        # optional paths parse as str; treat explicit ""/"None" as unset
+        for k in ("ckpt_dir", "metrics_path", "tb_logdir", "profile_dir"):
+            if kw[k] in (None, "", "None"):
+                kw[k] = None
+        return cls(mesh=mesh, **kw)
+
+    # -- (de)serialization -------------------------------------------------
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "RunConfig":
+        d = dict(d)
+        mesh = MeshSpec(**d.pop("mesh", {}))
+        names = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - names
+        if unknown:
+            raise ValueError(f"unknown RunConfig keys: {sorted(unknown)}")
+        return cls(mesh=mesh, **d)
+
+    def save(self, path: str | Path) -> None:
+        Path(path).parent.mkdir(parents=True, exist_ok=True)
+        Path(path).write_text(self.to_json())
+
+    @classmethod
+    def load(cls, path: str | Path) -> "RunConfig":
+        return cls.from_dict(json.loads(Path(path).read_text()))
+
+    # -- environment application ------------------------------------------
+
+    def apply_platform(self) -> None:
+        """Honor ``fake_devices`` BEFORE importing/initializing jax devices."""
+        if self.fake_devices:
+            import os
+
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            flags = os.environ.get("XLA_FLAGS", "")
+            opt = f"--xla_force_host_platform_device_count={self.fake_devices}"
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = f"{flags} {opt}".strip()

@@ -111,6 +111,40 @@ class StepCounterHook(BaseHook):
         self._t0, self._step0 = now, step
 
 
+class TensorBoardHook(BaseHook):
+    """Write scalar metrics as real TensorBoard event files — the closest
+    sibling of SummarySaverHook
+    (tensorflow/python/training/basic_session_run_hooks.py:793), using the
+    dependency-free proto encoder in utils/tb_writer.py. Chief-only."""
+
+    def __init__(self, logdir, every_steps: int = 1):
+        self.logdir = logdir
+        self.every_steps = every_steps
+        self._writer = None
+
+    def begin(self, loop) -> None:
+        if self._writer is not None:  # elastic restart reuses hook instances
+            self._writer.close()
+            self._writer = None
+        if is_chief():
+            from distributed_tensorflow_guide_tpu.utils.tb_writer import (
+                SummaryWriter,
+            )
+
+            self._writer = SummaryWriter(self.logdir)
+
+    def after_step(self, step: int, metrics: Mapping[str, float]) -> None:
+        if self._writer and step % self.every_steps == 0:
+            self._writer.scalars(
+                step, {k: float(v) for k, v in metrics.items()}
+            )
+
+    def end(self, step: int) -> None:
+        if self._writer:
+            self._writer.close()
+            self._writer = None
+
+
 class MetricsJSONLHook(BaseHook):
     """Append one JSON object per logged step to a file — the SummarySaverHook
     (tensorflow/python/training/basic_session_run_hooks.py:793) equivalent,

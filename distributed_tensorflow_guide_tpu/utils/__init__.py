@@ -1,0 +1,9 @@
+from distributed_tensorflow_guide_tpu.utils.determinism import (  # noqa: F401
+    DeterminismReport,
+    check_runs,
+    check_topologies,
+)
+from distributed_tensorflow_guide_tpu.utils.tb_writer import (  # noqa: F401
+    SummaryWriter,
+    read_scalars,
+)

@@ -1,0 +1,116 @@
+"""Tracing/profiling — the guide has none; the TF runtime it drives ships a
+timeline/profiler (the TF wheel bundles ``_pywrap_profiler_plugin.so``; the
+reference itself never calls it, SURVEY.md §5 tracing row).
+
+TPU-native: ``jax.profiler`` writes XPlane traces viewable in
+TensorBoard/XProf. This module is a thin, dependency-free veneer:
+
+* :func:`trace` — context manager around ``jax.profiler.trace`` (start/stop
+  a trace into a logdir).
+* :func:`annotate` — host-side span annotation (``jax.profiler.TraceAnnotation``),
+  shows up as a named region on the host timeline.
+* :func:`step_annotation` — marks one training step so XProf's step-time
+  analysis can segment the timeline (``StepTraceAnnotation``).
+* :func:`save_memory_profile` — dump a device-memory profile (pprof format).
+* :class:`ProfilerHook` — train-loop hook that traces steps
+  ``[start_step, end_step)``; the TF sibling is ``tf.train.ProfilerHook``
+  (tensorflow/python/training/basic_session_run_hooks.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from pathlib import Path
+from typing import Iterator
+
+import jax
+
+from distributed_tensorflow_guide_tpu.train.hooks import BaseHook
+
+log = logging.getLogger("dtg.profiling")
+
+
+@contextlib.contextmanager
+def trace(logdir: str | Path, *, create_perfetto_link: bool = False) -> Iterator[None]:
+    """Trace everything inside the block into ``logdir`` (XPlane format).
+
+    View with ``tensorboard --logdir <logdir>`` (profile tab / XProf).
+    """
+    logdir = str(logdir)
+    with jax.profiler.trace(logdir, create_perfetto_link=create_perfetto_link):
+        yield
+    log.info("profiler trace written to %s", logdir)
+
+
+def annotate(name: str, **kwargs):
+    """Named host-side span; nests. Use around data loading, checkpointing,
+    eval — anything host-bound worth seeing on the trace timeline."""
+    return jax.profiler.TraceAnnotation(name, **kwargs)
+
+
+def step_annotation(step: int, name: str = "train"):
+    """Mark one step for XProf step-time analysis."""
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+def save_memory_profile(path: str | Path) -> None:
+    """Dump current device memory usage as a pprof profile."""
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    jax.profiler.save_device_memory_profile(str(path))
+
+
+class ProfilerHook(BaseHook):
+    """Trace steps ``[start_step, end_step)`` of the training loop into
+    ``logdir``. Chief-only is NOT enforced: on multi-host, every host traces
+    its own devices (XProf merges by host); pass ``chief_only=True`` to
+    restrict."""
+
+    def __init__(self, logdir: str | Path, start_step: int = 10,
+                 end_step: int = 15, chief_only: bool = False):
+        if end_step <= start_step:
+            raise ValueError("end_step must be > start_step")
+        self.logdir = str(logdir)
+        self.start_step = start_step
+        self.end_step = end_step
+        self.chief_only = chief_only
+        self._active = False
+
+    def _enabled(self) -> bool:
+        if not self.chief_only:
+            return True
+        from distributed_tensorflow_guide_tpu.core.dist import is_chief
+
+        return is_chief()
+
+    def begin(self, loop) -> None:
+        # covers start_step == loop's first step (incl. 0) and warm resumes
+        # that land inside the window, where the arming after_step never runs
+        if self._active:
+            # elastic restart reuses hook instances and the crashed attempt
+            # never ran end(); JAX allows one active trace, so close it out
+            jax.profiler.stop_trace()
+            self._active = False
+        first = getattr(loop, "step", 0)
+        if self._enabled() and self.start_step <= first < self.end_step:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+
+    def after_step(self, step: int, metrics) -> None:
+        # after_step(step) runs once step `step` is done; start the trace
+        # after step start_step-1 so it covers [start_step, end_step).
+        if not self._enabled():
+            return
+        if (not self._active and self.start_step <= step + 1 < self.end_step):
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif self._active and step + 1 >= self.end_step:
+            jax.profiler.stop_trace()
+            self._active = False
+            log.info("profiler trace for steps [%d, %d) written to %s",
+                     self.start_step, self.end_step, self.logdir)
+
+    def end(self, step: int) -> None:
+        if self._active:  # loop stopped mid-window
+            jax.profiler.stop_trace()
+            self._active = False

@@ -1,0 +1,223 @@
+"""Aux-subsystem tests: config system, determinism checker, TensorBoard
+writer, profiler hook (SURVEY.md §5 rows)."""
+
+import dataclasses
+import struct
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_tensorflow_guide_tpu.core.config import RunConfig
+from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec
+from distributed_tensorflow_guide_tpu.utils.determinism import (
+    check_runs,
+    check_topologies,
+)
+from distributed_tensorflow_guide_tpu.utils.tb_writer import (
+    SummaryWriter,
+    read_scalars,
+    _crc32c,
+)
+
+
+# -- config ------------------------------------------------------------------
+
+
+def test_config_roundtrip_json(tmp_path):
+    cfg = RunConfig(mesh=MeshSpec(data=2, model=4), steps=7, lr=0.5,
+                    ckpt_dir=str(tmp_path / "ck"))
+    p = tmp_path / "run.json"
+    cfg.save(p)
+    assert RunConfig.load(p) == cfg
+
+
+def test_config_from_argv_defaults_and_overrides():
+    cfg = RunConfig.from_argv([])
+    assert cfg == RunConfig()
+    cfg = RunConfig.from_argv(
+        ["--steps", "42", "--lr", "0.01", "--mesh-model", "2",
+         "--mesh-data", "-1", "--tb-logdir", "/tmp/tb"])
+    assert cfg.steps == 42 and cfg.lr == 0.01
+    assert cfg.mesh == MeshSpec(data=-1, model=2)
+    assert cfg.tb_logdir == "/tmp/tb"
+    assert cfg.ckpt_dir is None
+
+
+def test_config_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="unknown"):
+        RunConfig.from_dict({"stepz": 1})
+
+
+def test_config_field_coverage():
+    # every field is settable from the CLI (guards against drift)
+    names = {f.name for f in dataclasses.fields(RunConfig)} - {"mesh"}
+    parser = RunConfig.parser()
+    dests = {a.dest for a in parser._actions}
+    assert names <= dests
+
+
+# -- determinism checker -----------------------------------------------------
+
+
+def _toy_train(seed: int):
+    key = jax.random.PRNGKey(seed)
+    w = jax.random.normal(key, (4,))
+    out = []
+    for step in range(3):
+        loss = float(jnp.sum(w**2) * (step + 1))
+        out.append({"loss": loss})
+    return out
+
+
+def test_check_runs_passes_for_deterministic_fn():
+    rep = check_runs(_toy_train, seed=3, runs=3)
+    assert rep.ok and rep.max_abs_diff == 0.0
+
+
+def test_check_runs_catches_nondeterminism():
+    state = {"n": 0}
+
+    def flaky(seed):
+        state["n"] += 1
+        return [{"loss": 1.0 + 0.1 * state["n"]}]
+
+    rep = check_runs(flaky, runs=2)
+    assert not rep.ok
+    with pytest.raises(AssertionError):
+        rep.raise_if_failed()
+
+
+def test_check_runs_fails_on_one_sided_nan():
+    state = {"n": 0}
+
+    def diverges_once(seed):
+        state["n"] += 1
+        return [{"loss": float("nan") if state["n"] == 2 else 1.0}]
+
+    rep = check_runs(diverges_once, runs=2)
+    assert not rep.ok and "NaN" in rep.detail
+
+
+def test_check_topologies_tolerance():
+    def train(spec: MeshSpec, seed: int):
+        # topology-independent math with tiny fake jitter below rtol
+        eps = 1e-7 if spec.model > 1 else 0.0
+        return [{"loss": 1.0 + eps}]
+
+    rep = check_topologies(
+        train, [MeshSpec(data=-1), MeshSpec(data=-1, model=2)], rtol=1e-5)
+    assert rep.ok
+    rep = check_topologies(
+        train, [MeshSpec(data=-1), MeshSpec(data=-1, model=2)], rtol=1e-9)
+    assert not rep.ok
+
+
+# -- TensorBoard writer ------------------------------------------------------
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors
+    assert _crc32c(b"") == 0x0
+    assert _crc32c(b"123456789") == 0xE3069283
+    assert _crc32c(bytes(32)) == 0x8A9136AA
+
+
+def test_tb_roundtrip(tmp_path):
+    with SummaryWriter(tmp_path) as w:
+        w.scalars(1, {"loss": 2.5, "acc": 0.125})
+        w.scalars(2, {"loss": 1.25})
+    files = list(tmp_path.glob("events.out.tfevents.*"))
+    assert len(files) == 1
+    events = read_scalars(files[0])
+    assert events == [(1, {"loss": 2.5, "acc": 0.125}), (2, {"loss": 1.25})]
+
+
+def test_tb_file_structure_valid_tfrecord(tmp_path):
+    with SummaryWriter(tmp_path) as w:
+        w.scalars(5, {"x": 1.0})
+    raw = next(tmp_path.glob("events.*")).read_bytes()
+    (ln,) = struct.unpack_from("<Q", raw, 0)
+    first = raw[12:12 + ln]
+    # first record is the file_version event: field 3, "brain.Event:2"
+    assert b"brain.Event:2" in first
+
+
+def test_tb_truncated_tail_reads_complete_prefix(tmp_path):
+    with SummaryWriter(tmp_path) as w:
+        w.scalars(1, {"x": 1.0})
+        w.scalars(2, {"x": 2.0})
+    f = next(tmp_path.glob("events.*"))
+    raw = f.read_bytes()
+    f.write_bytes(raw[:-7])  # SIGKILL mid-write of the last record
+    assert read_scalars(f) == [(1, {"x": 1.0})]
+
+
+def test_tb_corruption_detected(tmp_path):
+    with SummaryWriter(tmp_path) as w:
+        w.scalars(1, {"x": 1.0})
+    f = next(tmp_path.glob("events.*"))
+    raw = bytearray(f.read_bytes())
+    raw[-6] ^= 0xFF  # flip a payload byte of the last record
+    f.write_bytes(bytes(raw))
+    with pytest.raises(ValueError, match="corrupt"):
+        read_scalars(f)
+
+
+# -- TensorBoardHook + ProfilerHook through a real loop ----------------------
+
+
+def test_tb_hook_in_train_loop(tmp_path):
+    from distributed_tensorflow_guide_tpu.train.hooks import TensorBoardHook
+
+    hook = TensorBoardHook(tmp_path, every_steps=2)
+
+    class FakeLoop:
+        step = 0
+
+    hook.begin(FakeLoop())
+    for s in range(4):
+        hook.after_step(s, {"loss": float(s)})
+    hook.end(4)
+    events = read_scalars(next(tmp_path.glob("events.*")))
+    assert [s for s, _ in events] == [0, 2]
+
+
+def test_profiler_hook_writes_trace(tmp_path):
+    from distributed_tensorflow_guide_tpu.utils.profiling import ProfilerHook
+
+    hook = ProfilerHook(tmp_path, start_step=2, end_step=4)
+    for s in range(6):
+        jnp.sum(jnp.ones(8)).block_until_ready()
+        hook.after_step(s, {})
+    hook.end(6)
+    assert not hook._active
+    # jax.profiler.trace writes plugins/profile/<run>/ under the logdir
+    assert list(tmp_path.rglob("*.xplane.pb")), "no xplane trace written"
+
+
+def test_profiler_hook_start_step_zero(tmp_path):
+    from distributed_tensorflow_guide_tpu.utils.profiling import ProfilerHook
+
+    hook = ProfilerHook(tmp_path, start_step=0, end_step=2)
+
+    class FakeLoop:
+        step = 0
+
+    hook.begin(FakeLoop())
+    assert hook._active
+    for s in range(3):
+        jnp.sum(jnp.ones(8)).block_until_ready()
+        hook.after_step(s, {})
+    assert not hook._active
+    assert list(tmp_path.rglob("*.xplane.pb"))
+
+
+def test_profiler_hook_stops_on_early_end(tmp_path):
+    from distributed_tensorflow_guide_tpu.utils.profiling import ProfilerHook
+
+    hook = ProfilerHook(tmp_path, start_step=1, end_step=100)
+    hook.after_step(0, {})
+    assert hook._active
+    hook.end(1)
+    assert not hook._active
