@@ -89,7 +89,7 @@ def axis_size(axis: str) -> int:
     return lax.axis_size(axis)
 
 
-def psum(x, axis: str):
+def psum(x, axis: str | tuple[str, ...]):
     """All-reduce sum — replaces NcclAllReduce
     (tensorflow/python/distribute/cross_device_ops.py:961) and the reference's
     SyncReplicasOptimizer accumulator+token-queue barrier
@@ -98,7 +98,7 @@ def psum(x, axis: str):
     return lax.psum(x, axis)
 
 
-def pmean(x, axis: str):
+def pmean(x, axis: str | tuple[str, ...]):
     """All-reduce mean — gradient averaging across the data axis."""
     _record("pmean", axis, x)
     return lax.pmean(x, axis)

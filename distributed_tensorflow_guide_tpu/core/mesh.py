@@ -8,12 +8,13 @@ The reference wires up a *role-typed* cluster: parameter-server tasks hold
 variables, worker tasks compute.
 
 On TPU there are no roles. Topology is a single ``jax.sharding.Mesh`` with
-four named logical axes:
+five named logical axes:
 
     data     — data parallelism (sync allreduce; replaces PS/worker split)
     model    — tensor parallelism (param sharding; Megatron-style)
     pipe     — pipeline parallelism (stage sharding + ppermute microbatches)
     context  — sequence/context parallelism (ring attention KV rotation)
+    expert   — expert parallelism (MoE all_to_all token routing)
 
 Axis sizes are *config*, not process roles: every host runs the same program
 with the same MeshSpec (SPMD), and XLA lays collectives onto the ICI torus.
@@ -30,11 +31,11 @@ import numpy as np
 from jax.sharding import Mesh
 
 # Canonical logical axis order. Order matters for ICI locality under
-# create_device_mesh: later (inner) axes — pipe and context here — get the
+# create_device_mesh: later (inner) axes — pipe/context/expert here — get the
 # tightest physical rings. model sits second-outermost; configs that need
-# nearest-neighbor tensor-parallel rings should keep pipe/context at 1 (their
-# trailing size-1 dims are free) so model becomes the effective innermost axis.
-AXES = ("data", "model", "pipe", "context")
+# nearest-neighbor tensor-parallel rings should keep the trailing axes at 1
+# (size-1 dims are free) so model becomes the effective innermost axis.
+AXES = ("data", "model", "pipe", "context", "expert")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,6 +51,7 @@ class MeshSpec:
     model: int = 1
     pipe: int = 1
     context: int = 1
+    expert: int = 1
 
     def resolve(self, n_devices: int) -> dict[str, int]:
         """Resolve -1 entries against the device count; validate the product."""
@@ -116,9 +118,10 @@ def build_mesh(
 
 
 def single_device_mesh(device: jax.Device | None = None) -> Mesh:
-    """A 1x1x1x1 mesh — the Non-Distributed-Setup control (reference R2)."""
+    """An all-ones (1x1x1x1x1) mesh — the Non-Distributed-Setup control
+    (reference R2)."""
     device = device or jax.devices()[0]
-    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1), AXES)
+    return Mesh(np.asarray([device]).reshape((1,) * len(AXES)), AXES)
 
 
 def axis_sizes(mesh: Mesh) -> dict[str, int]:

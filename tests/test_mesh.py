@@ -24,12 +24,14 @@ def test_resolve_fill():
         "model": 2,
         "pipe": 1,
         "context": 1,
+        "expert": 1,
     }
 
 
 def test_resolve_exact():
     sizes = MeshSpec(data=2, model=2, pipe=2, context=1).resolve(8)
-    assert sizes == {"data": 2, "model": 2, "pipe": 2, "context": 1}
+    assert sizes == {"data": 2, "model": 2, "pipe": 2, "context": 1,
+                     "expert": 1}
 
 
 def test_resolve_rejects_bad_product():
@@ -41,14 +43,16 @@ def test_resolve_rejects_bad_product():
         MeshSpec(data=-1, model=-1).resolve(8)
 
 
-def test_4d_mesh_shape():
+def test_full_mesh_shape():
     mesh = build_mesh(MeshSpec(data=2, model=2, pipe=2, context=1))
-    assert mesh.devices.shape == (2, 2, 2, 1)
+    assert mesh.devices.shape == (2, 2, 2, 1, 1)
+    mesh = build_mesh(MeshSpec(data=2, expert=4))
+    assert mesh.devices.shape == (2, 1, 1, 1, 4)
 
 
 def test_single_device_mesh():
     mesh = single_device_mesh()
-    assert mesh.devices.shape == (1, 1, 1, 1)
+    assert mesh.devices.shape == (1,) * len(AXES)
     assert mesh.axis_names == AXES
 
 
