@@ -1,0 +1,14 @@
+from distributed_tensorflow_guide_tpu.data.native_loader import (  # noqa: F401
+    Field,
+    NativeRecordLoader,
+    PyRecordLoader,
+    make_fields,
+    open_record_loader,
+    write_records,
+)
+from distributed_tensorflow_guide_tpu.data.synthetic import (  # noqa: F401
+    SyntheticClassification,
+    SyntheticCTR,
+    synthetic_imagenet,
+    synthetic_mnist,
+)

@@ -1,0 +1,324 @@
+// Native host-side data loader — the C++ tier of the input pipeline.
+//
+// Role in the framework: the reference rides on TF's native input machinery
+// (its C++ runtime feeds sess.run via the wheel's compiled kernels); the
+// guide's Python only ever sees ready numpy batches. This file is the
+// TPU-framework equivalent: a memory-mapped fixed-record reader with
+// per-epoch shuffling, multi-threaded batch gather, and a background
+// prefetch ring, exposed to Python over a plain C ABI (ctypes — no pybind11
+// in this image). The Python fallback twin with identical semantics lives in
+// ../native_loader.py; tests assert bit-identical batch streams.
+//
+// Determinism contract: given (seed, epoch, shard_id, num_shards) the batch
+// stream is a pure function — the shuffle is a seeded xoshiro Fisher–Yates
+// over the global index space, sharded by contiguous blocks, so multi-host
+// runs read disjoint equal-size shards (SPMD data sharding, no PS).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+// xoshiro256** — tiny, fast, seedable; NOT libc rand (reproducible across
+// platforms, which the python twin mirrors exactly).
+struct Rng {
+  uint64_t s[4];
+  explicit Rng(uint64_t seed) {
+    // splitmix64 init
+    for (int i = 0; i < 4; i++) {
+      seed += 0x9e3779b97f4a7c15ULL;
+      uint64_t z = seed;
+      z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+      z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+      s[i] = z ^ (z >> 31);
+    }
+  }
+  static uint64_t rotl(uint64_t x, int k) { return (x << k) | (x >> (64 - k)); }
+  uint64_t next() {
+    uint64_t result = rotl(s[1] * 5, 7) * 9;
+    uint64_t t = s[1] << 17;
+    s[2] ^= s[0];
+    s[3] ^= s[1];
+    s[1] ^= s[2];
+    s[0] ^= s[3];
+    s[2] ^= t;
+    s[3] = rotl(s[3], 45);
+    return result;
+  }
+  // unbiased bounded draw (Lemire)
+  uint64_t bounded(uint64_t n) {
+    uint64_t x = next();
+    __uint128_t m = (__uint128_t)x * n;
+    uint64_t l = (uint64_t)m;
+    if (l < n) {
+      uint64_t t = (0 - n) % n;
+      while (l < t) {
+        x = next();
+        m = (__uint128_t)x * n;
+        l = (uint64_t)m;
+      }
+    }
+    return (uint64_t)(m >> 64);
+  }
+};
+
+struct Batch {
+  std::vector<uint8_t> buf;
+  int64_t seq = -1;        // which batch index this slot holds
+  bool ready = false;
+};
+
+struct Loader {
+  // immutable config
+  int fd = -1;
+  const uint8_t* map = nullptr;
+  size_t map_len = 0;
+  int64_t record_bytes = 0;
+  int64_t n_records = 0;       // global
+  int64_t batch_size = 0;
+  int64_t shard_id = 0, num_shards = 1;
+  int64_t n_threads = 4;
+  uint64_t seed = 0;
+  bool shuffle = true;
+
+  // per-epoch state
+  std::vector<int64_t> indices;  // this shard's record indices, epoch order
+  int64_t epoch = -1;
+  int64_t batches_per_epoch = 0;
+
+  // prefetch ring
+  std::vector<Batch> ring;
+  int64_t next_produce = 0;      // batch seq the producer fills next
+  int64_t next_consume = 0;      // batch seq the consumer takes next
+  std::mutex mu;
+  std::condition_variable cv_produce, cv_consume;
+  std::thread producer;
+  std::atomic<bool> stop{false};
+
+  // persistent gather pool (workers live for the loader's lifetime — a
+  // per-batch spawn/join would dominate small-batch gathers)
+  std::vector<std::thread> workers;
+  std::mutex pmu;
+  std::condition_variable cv_work, cv_done;
+  uint64_t work_gen = 0;
+  std::atomic<int64_t> work_pending{0};
+  uint8_t* work_dst = nullptr;
+  int64_t work_base = 0;
+  int64_t work_chunk = 0;
+
+  ~Loader() {
+    stop.store(true);
+    cv_produce.notify_all();
+    cv_consume.notify_all();
+    {
+      std::lock_guard<std::mutex> lk(pmu);
+      work_gen++;  // wake workers so they observe stop
+    }
+    cv_work.notify_all();
+    if (producer.joinable()) producer.join();
+    for (auto& w : workers)
+      if (w.joinable()) w.join();
+    if (map) munmap((void*)map, map_len);
+    if (fd >= 0) close(fd);
+  }
+
+  void copy_range(uint8_t* dst, int64_t base, int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; r++)
+      std::memcpy(dst + r * record_bytes,
+                  map + indices[base + r] * record_bytes,
+                  (size_t)record_bytes);
+  }
+
+  void worker_loop(int64_t id) {
+    uint64_t seen = 0;
+    while (true) {
+      uint8_t* dst;
+      int64_t base, lo, hi;
+      {
+        std::unique_lock<std::mutex> lk(pmu);
+        cv_work.wait(lk, [&] { return stop.load() || work_gen != seen; });
+        if (stop.load()) return;
+        seen = work_gen;
+        dst = work_dst;
+        base = work_base;
+        lo = id * work_chunk;
+        hi = std::min(batch_size, lo + work_chunk);
+      }
+      if (lo < hi) copy_range(dst, base, lo, hi);
+      if (work_pending.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk(pmu);
+        cv_done.notify_all();
+      }
+    }
+  }
+
+  void reshuffle(int64_t ep) {
+    epoch = ep;
+    int64_t shard_len = n_records / num_shards;  // drop tail remainder
+    indices.resize(shard_len);
+    if (shuffle) {
+      // global Fisher–Yates (every shard derives the same permutation, then
+      // takes its contiguous block → disjoint cover, identical on all hosts)
+      std::vector<int64_t> all(n_records);
+      for (int64_t i = 0; i < n_records; i++) all[i] = i;
+      Rng rng(seed * 0x9e3779b97f4a7c15ULL + (uint64_t)ep + 1);
+      for (int64_t i = n_records - 1; i > 0; i--) {
+        int64_t j = (int64_t)rng.bounded((uint64_t)i + 1);
+        std::swap(all[i], all[j]);
+      }
+      std::memcpy(indices.data(), all.data() + shard_id * shard_len,
+                  shard_len * sizeof(int64_t));
+    } else {
+      for (int64_t i = 0; i < shard_len; i++)
+        indices[i] = shard_id * shard_len + i;
+    }
+    batches_per_epoch = shard_len / batch_size;  // drop_remainder semantics
+  }
+
+  // gather one batch (seq within current epoch) into dst. Small batches are
+  // copied inline by the producer; larger ones fan out to the persistent
+  // pool. Workers only run while the producer blocks in cv_done, so they
+  // never race reshuffle()'s writes to `indices`.
+  void gather(int64_t seq, uint8_t* dst) {
+    const int64_t base = seq * batch_size;
+    int64_t nw = (int64_t)workers.size();
+    // inline threshold: pool dispatch costs ~2 wakeups; not worth it under
+    // ~64KB of copy work
+    if (nw == 0 || batch_size * record_bytes < (64 << 10)) {
+      copy_range(dst, base, 0, batch_size);
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lk(pmu);
+      work_dst = dst;
+      work_base = base;
+      work_chunk = (batch_size + nw - 1) / nw;
+      work_pending.store(nw);
+      work_gen++;
+    }
+    cv_work.notify_all();
+    std::unique_lock<std::mutex> lk(pmu);
+    cv_done.wait(lk, [&] { return work_pending.load() == 0; });
+  }
+
+  void producer_loop() {
+    while (!stop.load()) {
+      std::unique_lock<std::mutex> lk(mu);
+      int64_t slot = next_produce % (int64_t)ring.size();
+      cv_produce.wait(lk, [&] {
+        return stop.load() ||
+               (!ring[slot].ready && next_produce <
+                    (epoch + 1) * batches_per_epoch);
+      });
+      if (stop.load()) return;
+      int64_t seq = next_produce;
+      lk.unlock();
+      gather(seq % batches_per_epoch, ring[slot].buf.data());
+      lk.lock();
+      ring[slot].seq = seq;
+      ring[slot].ready = true;
+      next_produce++;
+      cv_consume.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns nullptr on failure. record_bytes must divide file size.
+void* dl_open(const char* path, int64_t record_bytes, int64_t batch_size,
+              int64_t shard_id, int64_t num_shards, int64_t prefetch,
+              int64_t n_threads, uint64_t seed, int shuffle) {
+  if (record_bytes <= 0 || batch_size <= 0 || num_shards <= 0 ||
+      shard_id < 0 || shard_id >= num_shards || prefetch <= 0)
+    return nullptr;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size == 0 ||
+      st.st_size % record_bytes != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, (size_t)st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (map == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  madvise(map, (size_t)st.st_size, MADV_WILLNEED);
+  auto* L = new Loader();
+  L->fd = fd;
+  L->map = (const uint8_t*)map;
+  L->map_len = (size_t)st.st_size;
+  L->record_bytes = record_bytes;
+  L->n_records = st.st_size / record_bytes;
+  L->batch_size = batch_size;
+  L->shard_id = shard_id;
+  L->num_shards = num_shards;
+  L->n_threads = n_threads > 0 ? n_threads : 1;
+  L->seed = seed;
+  L->shuffle = shuffle != 0;
+  L->reshuffle(0);
+  if (L->batches_per_epoch == 0) {
+    delete L;
+    return nullptr;
+  }
+  L->ring.resize((size_t)prefetch);
+  for (auto& b : L->ring) b.buf.resize((size_t)(batch_size * record_bytes));
+  int64_t nw = L->n_threads > batch_size ? batch_size : L->n_threads;
+  if (nw > 1)
+    for (int64_t i = 0; i < nw; i++)
+      L->workers.emplace_back(&Loader::worker_loop, L, i);
+  L->producer = std::thread(&Loader::producer_loop, L);
+  return L;
+}
+
+int64_t dl_batches_per_epoch(void* h) {
+  return ((Loader*)h)->batches_per_epoch;
+}
+
+int64_t dl_num_records(void* h) { return ((Loader*)h)->n_records; }
+
+// Blocking: copy the next batch into out (batch_size*record_bytes bytes).
+// Crossing an epoch boundary reshuffles transparently. Returns the global
+// batch sequence number, or -1 on error.
+int64_t dl_next(void* h, uint8_t* out) {
+  auto* L = (Loader*)h;
+  std::unique_lock<std::mutex> lk(L->mu);
+  int64_t seq = L->next_consume;
+  int64_t slot = seq % (int64_t)L->ring.size();
+  // epoch rollover: producer is gated at the epoch end; reshuffle, reopen
+  if (seq >= (L->epoch + 1) * L->batches_per_epoch) {
+    // wait until producer has no in-flight gather (all ready or idle)
+    L->reshuffle(L->epoch + 1);
+    L->cv_produce.notify_all();
+  }
+  L->cv_consume.wait(lk, [&] {
+    return L->stop.load() || (L->ring[slot].ready && L->ring[slot].seq == seq);
+  });
+  if (L->stop.load()) return -1;
+  lk.unlock();
+  std::memcpy(out, L->ring[slot].buf.data(),
+              (size_t)(L->batch_size * L->record_bytes));
+  lk.lock();
+  L->ring[slot].ready = false;
+  L->next_consume++;
+  L->cv_produce.notify_all();
+  return seq;
+}
+
+void dl_close(void* h) { delete (Loader*)h; }
+
+}  // extern "C"

@@ -1,0 +1,318 @@
+"""Python surface of the native (C++) data loader.
+
+The reference's input path is TF's compiled runtime (the wheel's native
+kernels feed ``sess.run``); the guide's Python never touches a record. This
+module gives the framework the same split: ``native/dataloader.cpp`` does
+mmap + per-epoch global shuffle + multi-threaded batch gather + background
+prefetch behind a C ABI, and this file compiles it on demand (g++ — no
+pybind11 in the image; ctypes is the binding) and wraps it in an iterator of
+numpy batches.
+
+``PyRecordLoader`` is the bit-identical pure-Python twin: same xoshiro256**
+RNG, same Fisher–Yates, same contiguous shard blocks — used as fallback when
+no compiler is available and as the oracle in tests (native and Python
+streams must match byte-for-byte).
+
+Records are fixed-size; structured samples are described by a ``fields``
+spec (name → dtype/shape) packed back-to-back, a deliberately boring format
+that mmaps well — the TPU-era answer to "what replaces the feed_dict".
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import logging
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Iterator, Mapping, Sequence
+
+import numpy as np
+
+log = logging.getLogger("dtg.data")
+
+_SRC = Path(__file__).parent / "native" / "dataloader.cpp"
+_LIB_CACHE: dict[str, ctypes.CDLL] = {}
+
+MASK64 = (1 << 64) - 1
+
+
+# -- build + bind ------------------------------------------------------------
+
+
+def _build_lib(cache_dir: str | Path | None = None) -> Path:
+    cache_dir = Path(cache_dir or os.environ.get(
+        "DTG_NATIVE_CACHE", Path.home() / ".cache" / "dtg_native"))
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    src_mtime = int(_SRC.stat().st_mtime)
+    so = cache_dir / f"dataloader_{src_mtime}.so"
+    if so.exists():
+        return so
+    tmp = so.with_suffix(f".build{os.getpid()}.so")
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           str(_SRC), "-o", str(tmp)]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so)  # atomic: concurrent builders race harmlessly
+    log.info("built native dataloader: %s", so)
+    return so
+
+
+def load_native_lib() -> ctypes.CDLL | None:
+    """Compile (cached) and bind the C ABI; None if no toolchain."""
+    try:
+        so = _build_lib()
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
+        log.warning("native dataloader unavailable (%s); using Python twin", e)
+        return None
+    key = str(so)
+    if key not in _LIB_CACHE:
+        lib = ctypes.CDLL(key)
+        lib.dl_open.restype = ctypes.c_void_p
+        lib.dl_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
+            ctypes.c_int,
+        ]
+        lib.dl_next.restype = ctypes.c_int64
+        lib.dl_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.dl_batches_per_epoch.restype = ctypes.c_int64
+        lib.dl_batches_per_epoch.argtypes = [ctypes.c_void_p]
+        lib.dl_num_records.restype = ctypes.c_int64
+        lib.dl_num_records.argtypes = [ctypes.c_void_p]
+        lib.dl_close.argtypes = [ctypes.c_void_p]
+        _LIB_CACHE[key] = lib
+    return _LIB_CACHE[key]
+
+
+# -- the shared RNG/shuffle spec (python twin of the C++) --------------------
+
+
+class _Xoshiro256ss:
+    """Exact Python port of the C++ Rng (xoshiro256** + splitmix64 seeding +
+    Lemire bounded draw). Keep in lockstep with native/dataloader.cpp."""
+
+    def __init__(self, seed: int):
+        self.s = []
+        seed &= MASK64
+        for _ in range(4):
+            seed = (seed + 0x9E3779B97F4A7C15) & MASK64
+            z = seed
+            z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & MASK64
+            z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & MASK64
+            self.s.append(z ^ (z >> 31))
+
+    @staticmethod
+    def _rotl(x: int, k: int) -> int:
+        return ((x << k) | (x >> (64 - k))) & MASK64
+
+    def next(self) -> int:
+        s = self.s
+        result = (self._rotl((s[1] * 5) & MASK64, 7) * 9) & MASK64
+        t = (s[1] << 17) & MASK64
+        s[2] ^= s[0]
+        s[3] ^= s[1]
+        s[1] ^= s[2]
+        s[0] ^= s[3]
+        s[2] ^= t
+        s[3] = self._rotl(s[3], 45)
+        return result
+
+    def bounded(self, n: int) -> int:
+        x = self.next()
+        m = x * n
+        low = m & MASK64
+        if low < n:
+            t = (1 << 64) % n
+            while low < t:
+                x = self.next()
+                m = x * n
+                low = m & MASK64
+        return m >> 64
+
+
+def epoch_permutation(n_records: int, seed: int, epoch: int) -> np.ndarray:
+    """The global shuffle both implementations use: seeded Fisher–Yates."""
+    rng = _Xoshiro256ss((seed * 0x9E3779B97F4A7C15 + epoch + 1) & MASK64)
+    idx = np.arange(n_records, dtype=np.int64)
+    for i in range(n_records - 1, 0, -1):
+        j = rng.bounded(i + 1)
+        idx[i], idx[j] = idx[j], idx[i]
+    return idx
+
+
+# -- record/field plumbing ---------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: np.dtype
+    shape: tuple[int, ...]
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.dtype(self.dtype).itemsize * np.prod(self.shape or (1,)))
+
+
+def make_fields(spec: Mapping[str, tuple]) -> list[Field]:
+    """spec: name -> (dtype, shape). Order defines the packed layout."""
+    return [Field(n, np.dtype(d), tuple(s)) for n, (d, s) in spec.items()]
+
+
+def record_bytes(fields: Sequence[Field]) -> int:
+    return sum(f.nbytes for f in fields)
+
+
+def write_records(path: str | Path, columns: Mapping[str, np.ndarray],
+                  fields: Sequence[Field]) -> int:
+    """Pack columns (leading dim = record index) into the flat record file."""
+    n = len(next(iter(columns.values())))
+    rb = record_bytes(fields)
+    buf = np.zeros((n, rb), np.uint8)
+    off = 0
+    for f in fields:
+        col = np.ascontiguousarray(columns[f.name], dtype=f.dtype)
+        if len(col) != n:
+            raise ValueError(f"column {f.name} length {len(col)} != {n}")
+        flat = col.reshape(n, -1).view(np.uint8).reshape(n, f.nbytes)
+        buf[:, off:off + f.nbytes] = flat
+        off += f.nbytes
+    Path(path).write_bytes(buf.tobytes())
+    return n
+
+
+def _split_batch(raw: np.ndarray, fields: Sequence[Field]) -> dict:
+    """raw (B, record_bytes) uint8 -> {name: (B, *shape) typed array}."""
+    out = {}
+    off = 0
+    b = raw.shape[0]
+    for f in fields:
+        chunk = raw[:, off:off + f.nbytes]
+        out[f.name] = np.ascontiguousarray(chunk).view(f.dtype).reshape(
+            (b,) + f.shape)
+        off += f.nbytes
+    return out
+
+
+# -- loaders -----------------------------------------------------------------
+
+
+class NativeRecordLoader:
+    """Iterator of field-dict batches backed by the C++ prefetch ring."""
+
+    def __init__(self, path: str | Path, fields: Sequence[Field],
+                 batch_size: int, *, shard_id: int = 0, num_shards: int = 1,
+                 shuffle: bool = True, seed: int = 0, prefetch: int = 4,
+                 n_threads: int = 4):
+        self.fields = list(fields)
+        self.batch_size = batch_size
+        self._rb = record_bytes(self.fields)
+        lib = load_native_lib()
+        if lib is None:
+            raise RuntimeError("native loader unavailable; use PyRecordLoader")
+        self._lib = lib
+        self._h = lib.dl_open(str(path).encode(), self._rb, batch_size,
+                              shard_id, num_shards, prefetch, n_threads,
+                              ctypes.c_uint64(seed & MASK64), int(shuffle))
+        if not self._h:
+            raise ValueError(
+                f"dl_open failed for {path} (record_bytes={self._rb}, "
+                f"batch={batch_size}, shard {shard_id}/{num_shards} — file "
+                "must be a whole number of records and >= one batch/shard)")
+        self._buf = ctypes.create_string_buffer(batch_size * self._rb)
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return int(self._lib.dl_batches_per_epoch(self._h))
+
+    @property
+    def num_records(self) -> int:
+        return int(self._lib.dl_num_records(self._h))
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            yield self.next_batch()
+
+    def next_batch(self) -> dict:
+        seq = self._lib.dl_next(self._h, self._buf)
+        if seq < 0:
+            raise RuntimeError("dl_next failed")
+        raw = np.frombuffer(self._buf, np.uint8).reshape(
+            self.batch_size, self._rb).copy()
+        return _split_batch(raw, self.fields)
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.dl_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PyRecordLoader:
+    """Pure-Python twin: same files, same order, no threads. Oracle for the
+    native loader's tests and fallback when g++ is missing."""
+
+    def __init__(self, path: str | Path, fields: Sequence[Field],
+                 batch_size: int, *, shard_id: int = 0, num_shards: int = 1,
+                 shuffle: bool = True, seed: int = 0):
+        self.fields = list(fields)
+        self.batch_size = batch_size
+        self._rb = record_bytes(self.fields)
+        data = np.fromfile(str(path), np.uint8)
+        if data.size == 0 or data.size % self._rb:
+            raise ValueError(f"{path}: not a whole number of records")
+        self._records = data.reshape(-1, self._rb)
+        self.num_records = len(self._records)
+        self.shard_id, self.num_shards = shard_id, num_shards
+        self.shuffle, self.seed = shuffle, seed
+        self._epoch = -1
+        self._indices: np.ndarray | None = None
+        self._advance_epoch()
+        if self.batches_per_epoch == 0:
+            raise ValueError("shard smaller than one batch")
+        self._pos = 0
+
+    def _advance_epoch(self) -> None:
+        self._epoch += 1
+        shard_len = self.num_records // self.num_shards
+        if self.shuffle:
+            perm = epoch_permutation(self.num_records, self.seed, self._epoch)
+            self._indices = perm[self.shard_id * shard_len:
+                                 (self.shard_id + 1) * shard_len]
+        else:
+            self._indices = np.arange(self.shard_id * shard_len,
+                                      (self.shard_id + 1) * shard_len)
+        self.batches_per_epoch = shard_len // self.batch_size
+        self._pos = 0
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            yield self.next_batch()
+
+    def next_batch(self) -> dict:
+        if self._pos >= self.batches_per_epoch:
+            self._advance_epoch()
+        idx = self._indices[self._pos * self.batch_size:
+                            (self._pos + 1) * self.batch_size]
+        self._pos += 1
+        return _split_batch(self._records[idx], self.fields)
+
+    def close(self) -> None:
+        pass
+
+
+def open_record_loader(path, fields, batch_size, **kw):
+    """Native if a toolchain exists, Python twin otherwise."""
+    try:
+        return NativeRecordLoader(path, fields, batch_size, **kw)
+    except RuntimeError:
+        kw.pop("prefetch", None)
+        kw.pop("n_threads", None)
+        return PyRecordLoader(path, fields, batch_size, **kw)

@@ -185,27 +185,31 @@ class ExpertParallel:
         )
 
     def apply(self, params: dict, x: jax.Array):
-        """Jitted sharded forward: x (T_global, d) -> (y, aux)."""
-        cfg = self.cfg
+        """Jitted sharded forward: x (T_global, d) -> (y, aux). The jitted
+        function is built once (per instance) so repeated calls hit the
+        trace cache instead of recompiling."""
+        if not hasattr(self, "_apply_jit"):
+            cfg = self.cfg
 
-        @functools.partial(
-            jax.jit,
-            in_shardings=(
-                {k: NamedSharding(self.mesh, s)
-                 for k, s in self.param_spec.items()},
-                NamedSharding(self.mesh, self.token_spec),
-            ),
-        )
-        def run(params, x):
-            fn = functools.partial(moe_ffn, cfg=cfg)
-            return jax.shard_map(
-                fn, mesh=self.mesh,
-                in_specs=(self.param_spec, self.token_spec),
-                out_specs=(self.token_spec, P()),
-                check_vma=False,
-            )(params, x)
+            @functools.partial(
+                jax.jit,
+                in_shardings=(
+                    {k: NamedSharding(self.mesh, s)
+                     for k, s in self.param_spec.items()},
+                    NamedSharding(self.mesh, self.token_spec),
+                ),
+            )
+            def run(params, x):
+                fn = functools.partial(moe_ffn, cfg=cfg)
+                return jax.shard_map(
+                    fn, mesh=self.mesh,
+                    in_specs=(self.param_spec, self.token_spec),
+                    out_specs=(self.token_spec, P()),
+                    check_vma=False,
+                )(params, x)
 
-        return run(params, x)
+            self._apply_jit = run
+        return self._apply_jit(params, x)
 
     def make_train_step(self, lr: float = 0.1, *, aux_weight: float = 1e-2):
         """Jitted SGD step on an MSE toy objective — exercises the full EP
@@ -227,13 +231,16 @@ class ExpertParallel:
 
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params)
-            # replicated router: reduce grads over every token-shard axis;
-            # expert stacks: their token contributions already arrived via
-            # the backward all_to_all, reduce over data only
-            grads["router"] = cc.pmean(grads["router"], cfg.token_axes)
+            # the loss is already the GLOBAL mean, so each device's grad is a
+            # partial contribution and the reduction is psum (a pmean here
+            # would under-scale by the axis size). Replicated router: sum
+            # over every token-shard axis; expert stacks: contributions from
+            # the expert axis already arrived via the backward all_to_all,
+            # so sum over data only.
+            grads["router"] = cc.psum(grads["router"], cfg.token_axes)
             if cfg.data_axis:
-                grads["w_in"] = cc.pmean(grads["w_in"], cfg.data_axis)
-                grads["w_out"] = cc.pmean(grads["w_out"], cfg.data_axis)
+                grads["w_in"] = cc.psum(grads["w_in"], cfg.data_axis)
+                grads["w_out"] = cc.psum(grads["w_out"], cfg.data_axis)
             params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
                                   params, grads)
             return params, {"loss": loss, **aux}
