@@ -90,6 +90,20 @@ def initialize(config: DistConfig | None = None) -> None:
     ):
         log.debug("single-process run; skipping jax.distributed.initialize")
         return
+    # Re-assert the env-requested platform/device-count post-import: PJRT
+    # plugins (e.g. the local axon TPU plugin) can override JAX_PLATFORMS
+    # during `import jax`, and JAX_NUM_CPU_DEVICES is this framework's env
+    # convention (the launcher sets it), not a flag JAX reads itself. Done
+    # only on the env-driven multi-host path: single-process calls stay pure
+    # no-ops (config.update raises once backends are live), and an explicit
+    # config keeps its no-env-leakage guarantee (comment above).
+    if not explicit:
+        plat = os.environ.get("JAX_PLATFORMS")
+        if plat:
+            jax.config.update("jax_platforms", plat)
+        ndev = os.environ.get("JAX_NUM_CPU_DEVICES")
+        if ndev:
+            jax.config.update("jax_num_cpu_devices", int(ndev))
     kwargs = {}
     if coord is not None:
         kwargs["coordinator_address"] = coord

@@ -125,6 +125,7 @@ struct Loader {
       work_gen++;  // wake workers so they observe stop
     }
     cv_work.notify_all();
+    cv_done.notify_all();  // free a producer blocked in gather()'s wait
     if (producer.joinable()) producer.join();
     for (auto& w : workers)
       if (w.joinable()) w.join();
@@ -162,10 +163,12 @@ struct Loader {
     }
   }
 
-  void reshuffle(int64_t ep) {
-    epoch = ep;
+  // Build epoch `ep`'s index list for this shard into `out`. Pure and
+  // lock-free — the O(n_records) part, run off the consumer's critical path.
+  // Returns false if aborted by `stop` (shutdown during a huge shuffle).
+  bool build_indices(int64_t ep, std::vector<int64_t>& out) {
     int64_t shard_len = n_records / num_shards;  // drop tail remainder
-    indices.resize(shard_len);
+    out.resize(shard_len);
     if (shuffle) {
       // global Fisher–Yates (every shard derives the same permutation, then
       // takes its contiguous block → disjoint cover, identical on all hosts)
@@ -173,16 +176,26 @@ struct Loader {
       for (int64_t i = 0; i < n_records; i++) all[i] = i;
       Rng rng(seed * 0x9e3779b97f4a7c15ULL + (uint64_t)ep + 1);
       for (int64_t i = n_records - 1; i > 0; i--) {
+        if ((i & 0xfffff) == 0 && stop.load()) return false;
         int64_t j = (int64_t)rng.bounded((uint64_t)i + 1);
         std::swap(all[i], all[j]);
       }
-      std::memcpy(indices.data(), all.data() + shard_id * shard_len,
+      std::memcpy(out.data(), all.data() + shard_id * shard_len,
                   shard_len * sizeof(int64_t));
     } else {
       for (int64_t i = 0; i < shard_len; i++)
-        indices[i] = shard_id * shard_len + i;
+        out[i] = shard_id * shard_len + i;
     }
-    batches_per_epoch = shard_len / batch_size;  // drop_remainder semantics
+    return true;
+  }
+
+  // Caller must guarantee no gather is in flight (only the producer thread
+  // issues gathers, and dl_open installs before threads start).
+  void install_epoch(int64_t ep, std::vector<int64_t>&& idx) {
+    indices = std::move(idx);
+    epoch = ep;
+    batches_per_epoch =
+        (int64_t)indices.size() / batch_size;  // drop_remainder semantics
   }
 
   // gather one batch (seq within current epoch) into dst. Small batches are
@@ -208,18 +221,29 @@ struct Loader {
     }
     cv_work.notify_all();
     std::unique_lock<std::mutex> lk(pmu);
-    cv_done.wait(lk, [&] { return work_pending.load() == 0; });
+    // stop also releases this wait: a worker woken during shutdown returns
+    // without decrementing work_pending, so the count may never hit zero.
+    cv_done.wait(lk, [&] { return stop.load() || work_pending.load() == 0; });
   }
 
   void producer_loop() {
     while (!stop.load()) {
       std::unique_lock<std::mutex> lk(mu);
+      // Epoch rollover happens HERE, on the producer thread: the consumer
+      // keeps draining already-gathered ring slots while the O(n_records)
+      // permutation is rebuilt with no lock held, so the training loop never
+      // stalls on the shuffle. Safe w.r.t. workers: this thread issues every
+      // gather, so none is in flight while it runs install_epoch.
+      if (next_produce >= (epoch + 1) * batches_per_epoch) {
+        int64_t ep = epoch + 1;
+        lk.unlock();
+        std::vector<int64_t> idx;
+        if (!build_indices(ep, idx)) return;  // aborted by stop
+        lk.lock();
+        install_epoch(ep, std::move(idx));
+      }
       int64_t slot = next_produce % (int64_t)ring.size();
-      cv_produce.wait(lk, [&] {
-        return stop.load() ||
-               (!ring[slot].ready && next_produce <
-                    (epoch + 1) * batches_per_epoch);
-      });
+      cv_produce.wait(lk, [&] { return stop.load() || !ring[slot].ready; });
       if (stop.load()) return;
       int64_t seq = next_produce;
       lk.unlock();
@@ -270,7 +294,11 @@ void* dl_open(const char* path, int64_t record_bytes, int64_t batch_size,
   L->n_threads = n_threads > 0 ? n_threads : 1;
   L->seed = seed;
   L->shuffle = shuffle != 0;
-  L->reshuffle(0);
+  {
+    std::vector<int64_t> idx;
+    L->build_indices(0, idx);
+    L->install_epoch(0, std::move(idx));
+  }
   if (L->batches_per_epoch == 0) {
     delete L;
     return nullptr;
@@ -299,12 +327,8 @@ int64_t dl_next(void* h, uint8_t* out) {
   std::unique_lock<std::mutex> lk(L->mu);
   int64_t seq = L->next_consume;
   int64_t slot = seq % (int64_t)L->ring.size();
-  // epoch rollover: producer is gated at the epoch end; reshuffle, reopen
-  if (seq >= (L->epoch + 1) * L->batches_per_epoch) {
-    // wait until producer has no in-flight gather (all ready or idle)
-    L->reshuffle(L->epoch + 1);
-    L->cv_produce.notify_all();
-  }
+  // Epoch rollover is the producer's job (see producer_loop); the consumer
+  // just waits for its slot.
   L->cv_consume.wait(lk, [&] {
     return L->stop.load() || (L->ring[slot].ready && L->ring[slot].seq == seq);
   });

@@ -44,8 +44,21 @@ class DataParallel:
 
     # ---- data placement ----------------------------------------------------
     def shard_batch(self, batch: Any) -> Any:
-        """Place a host batch onto the mesh, sharded along leading axis."""
+        """Place a host batch onto the mesh, sharded along the leading axis.
+
+        Single-process: ``batch`` is the global batch. Multi-process SPMD:
+        ``batch`` is this process's equal share (global/process_count rows,
+        e.g. from a process-sharded data loader) and the global array is
+        assembled shard-wise — each host's rows land on its own devices, no
+        cross-host transfer (the TF analogue is per-worker input pipelines
+        under MultiWorkerMirroredStrategy, not one host scattering to all).
+        """
         sharding = NamedSharding(self.mesh, P(self.axis))
+        if jax.process_count() > 1:
+            return jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(sharding, x),
+                batch,
+            )
         return jax.device_put(batch, sharding)
 
     def replicate(self, state: Any) -> Any:

@@ -62,6 +62,51 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def supervise(
+    procs: Sequence[subprocess.Popen],
+    *,
+    timeout: float,
+    failure_grace: float,
+    on_first_failure: Callable[[int, int], None] | None = None,
+) -> bool:
+    """Poll a process group until all exit, the first failure's grace period
+    expires, or the deadline hits; then kill and reap any stragglers.
+
+    The shared supervision core for both the test runner (:class:`
+    MultiProcessRunner`) and the CLI launcher (launch.py): the moment any
+    process exits nonzero, ``on_first_failure(process_id, code)`` fires once
+    and the survivors get ``failure_grace`` seconds (peers blocked in a
+    collective on the dead rank never finish) before being killed. Returns
+    True iff the wall-clock deadline was hit.
+    """
+    deadline = time.monotonic() + timeout
+    fail_deadline = None
+    timed_out = False
+    while True:
+        codes = [p.poll() for p in procs]
+        if all(c is not None for c in codes):
+            break
+        now = time.monotonic()
+        if fail_deadline is None and any(c not in (None, 0) for c in codes):
+            if on_first_failure is not None:
+                bad = next(
+                    i for i, c in enumerate(codes) if c not in (None, 0)
+                )
+                on_first_failure(bad, codes[bad])
+            fail_deadline = now + failure_grace
+        if now >= deadline:
+            timed_out = True
+            break
+        if fail_deadline is not None and now >= fail_deadline:
+            break
+        time.sleep(0.05)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    return timed_out
+
+
 @dataclasses.dataclass
 class ProcessResult:
     process_id: int
@@ -178,27 +223,10 @@ class MultiProcessRunner:
         of hanging to the full timeout the way the reference's run.sh peers
         hang on a dead PS.
         """
-        deadline = time.monotonic() + self.timeout
-        fail_deadline = None
-        timed_out = False
-        while True:
-            codes = [p.poll() for p in self._procs]
-            if all(c is not None for c in codes):
-                break
-            now = time.monotonic()
-            if any(c not in (None, 0) for c in codes) and fail_deadline is None:
-                fail_deadline = now + failure_grace
-            if now >= deadline:
-                timed_out = True
-                break
-            if fail_deadline is not None and now >= fail_deadline:
-                break
-            time.sleep(0.05)
-        # Reap everything still running (the supervision run.sh never had).
-        for p in self._procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait()
+        # Reap-on-failure supervision run.sh never had.
+        timed_out = supervise(
+            self._procs, timeout=self.timeout, failure_grace=failure_grace
+        )
         results = []
         for pid, (p, (out, err)) in enumerate(zip(self._procs, self._files)):
             out.flush()

@@ -72,9 +72,11 @@ def main() -> None:
                   fields)
 
     # 2. native loader shards by PROCESS (multi-host: each host reads its
-    #    block); within a host DataParallel shards the batch over devices
+    #    block); within a host DataParallel shards the batch over devices.
+    #    Each process draws its 1/num_processes share of the global batch.
+    per_process_batch = args.global_batch // jax.process_count()
     loader = open_record_loader(
-        tmp, fields, args.global_batch,
+        tmp, fields, per_process_batch,
         shard_id=jax.process_index(), num_shards=jax.process_count(),
         shuffle=True, seed=0, prefetch=4, n_threads=4)
     logging.info("loader: %s, %d records, %d batches/epoch",
@@ -101,8 +103,9 @@ def main() -> None:
             logging.info("step %3d  loss=%.4f", s, loss)
     jax.block_until_ready(state)
     dt = time.perf_counter() - t0
-    logging.info("%.1f examples/sec end-to-end (native input + device step)",
-                 args.steps * args.global_batch / dt)
+    logging.info("%.1f examples/sec/process end-to-end "
+                 "(native input + device step)",
+                 args.steps * per_process_batch / dt)
     loader.close()
 
 

@@ -1,0 +1,63 @@
+"""Target script for launcher tests — run only via subprocess, never imported
+by pytest (no test_ prefix).
+
+Each launched process initializes from the env the launcher set, psums its
+process index across the cluster, and prints a checkable line. With
+``--fail-rank K`` process K exits 1 *before* the collective, so the peers
+block in it — exercising the launcher's failure-grace supervision.
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fail-rank", type=int, default=-1)
+    ns = ap.parse_args()
+
+    from distributed_tensorflow_guide_tpu.core import dist
+
+    dist.initialize()
+    import jax
+    import jax.numpy as jnp
+
+    if ns.fail_rank >= 0:
+        # Supervision scenario: one rank dies, the rest hang in host-side
+        # work (immune to the coordination-service death notification that
+        # aborts peers blocked in collectives) and must be reaped by grace.
+        if jax.process_index() == ns.fail_rank:
+            print("injected failure", flush=True)
+            # os._exit: an abrupt death (like a segfault/OOM-kill), skipping
+            # jax.distributed's atexit shutdown barrier — sys.exit would hang
+            # there waiting for the surviving ranks.
+            import os
+            os._exit(1)
+        import time
+        time.sleep(300)
+        sys.exit(0)
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(jax.devices(), ("data",))
+    ranks = jnp.arange(jax.device_count(), dtype=jnp.int32)
+    ranks = jax.device_put(ranks, NamedSharding(mesh, P("data")))
+    total = jax.jit(
+        shard_map(
+            lambda x: jax.lax.psum(x, "data"),
+            mesh=mesh, in_specs=P("data"), out_specs=P(),
+        )
+    )(ranks)
+    print(
+        f"RANKSUM process={jax.process_index()} "
+        f"nproc={jax.process_count()} sum={int(total[0])}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Launcher CLI (launch.py) — run.sh-equivalent supervision (SURVEY.md §2 R9).
+
+Spawns the launcher itself as a subprocess (it spawns its own children), so
+these tests exercise the full CLI path end to end on fake CPU devices.
+"""
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+TARGET = str(REPO / "tests" / "launch_target.py")
+
+
+def _launch(*extra: str, timeout: float = 240.0):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # children configure their own device counts
+    return subprocess.run(
+        [sys.executable, "-m", "distributed_tensorflow_guide_tpu.launch",
+         *extra],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_two_process_psum_through_launcher():
+    r = _launch(
+        "-n", "2", "--devices-per-process", "2", "--platform", "cpu",
+        "--timeout", "180", TARGET,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    # 4 global devices -> sum(0..3) = 6, reported by both processes.
+    ranksums = [l for l in r.stdout.splitlines() if "RANKSUM" in l]
+    assert len(ranksums) == 2, r.stdout
+    assert all("nproc=2" in l and "sum=6" in l for l in ranksums), ranksums
+
+
+def test_failure_supervision_reaps_survivors_fast():
+    t0 = time.monotonic()
+    r = _launch(
+        "-n", "2", "--platform", "cpu", "--timeout", "180",
+        # Rank 1 dies; rank 0 hangs in host-side work (a 300s sleep, so a
+        # pass can only come from grace-reaping, not natural exit).
+        "--failure-grace", "5", TARGET, "--fail-rank", "1",
+    )
+    elapsed = time.monotonic() - t0
+    assert r.returncode == 1, r.stdout + r.stderr
+    # Survivor was blocked in the collective on the dead rank; the launcher
+    # must reap it within grace, not hang to the full timeout.
+    assert elapsed < 120, f"supervision too slow: {elapsed:.0f}s"
+    assert "giving survivors" in r.stdout + r.stderr
+
+
+def test_log_dir_written(tmp_path):
+    r = _launch(
+        "-n", "2", "--devices-per-process", "1", "--platform", "cpu",
+        "--timeout", "180", "--log-dir", str(tmp_path), TARGET,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    for k in range(2):
+        log = (tmp_path / f"p{k}.log").read_text()
+        assert "RANKSUM" in log
