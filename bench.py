@@ -74,17 +74,16 @@ def main() -> None:
         }
     )
 
-    # warmup / compile
-    for _ in range(3):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(state)
+    # Timing is closed by materializing host values that data-depend on the
+    # final step's loss AND updated params (the steps chain through `state`).
+    # block_until_ready alone does not reliably fence execution on every PJRT
+    # transport (measured: the axon tunnel acks readiness early, inflating
+    # throughput ~25x); a scalar fetch cannot complete before the compute it
+    # depends on. The shared implementation lives in benchmarks/common.py.
+    from benchmarks.common import time_steps
 
     n_steps = 20
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(state)
-    dt = time.perf_counter() - t0
+    dt, state = time_steps(step, state, batch, warmup=3, steps=n_steps)
 
     images_per_sec_per_chip = global_batch * n_steps / dt / n_dev
     print(

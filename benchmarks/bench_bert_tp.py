@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Judged config 3: BERT-base classification, parameter-sharded over the
+``model`` mesh axis (TensorParallel / pjit — the ParameterServerStrategy
+equivalent, tensorflow/python/distribute/parameter_server_strategy_v2.py:77).
+
+Metric: sequences/sec at seq_len 128 (full 12-layer BERT-base by default)."""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.common import device_setup, report, time_steps
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--global-batch", type=int, default=64)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--model-parallel", type=int, default=1,
+                    help=">1 needs that many devices (e.g. --fake-devices 8 "
+                         "--model-parallel 4)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--fake-devices", type=int, default=0)
+    args = ap.parse_args()
+
+    device_setup(args.fake_devices)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax.training import train_state
+
+    from distributed_tensorflow_guide_tpu.core.dist import initialize
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        Transformer,
+        bert_base,
+        make_cls_loss_fn,
+    )
+    from distributed_tensorflow_guide_tpu.parallel.tensor import TensorParallel
+
+    initialize()
+    mesh = build_mesh(MeshSpec(data=-1, model=args.model_parallel))
+    cfg = bert_base(num_classes=2, dtype=jnp.bfloat16)
+    cfg = type(cfg)(**{**cfg.__dict__, "num_layers": args.layers,
+                       "max_len": args.seq_len})
+    model = Transformer(cfg)
+    tp = TensorParallel(mesh)
+
+    sample = jnp.zeros((1, cfg.max_len), jnp.int32)
+    params, shardings = tp.init_params(model, jax.random.PRNGKey(0), sample)
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.adamw(1e-4))
+    st_shard = tp.state_shardings(state, shardings)
+    state = jax.device_put(state, st_shard)
+    step = tp.make_train_step(make_cls_loss_fn(model), st_shard)
+
+    r = np.random.RandomState(0)
+    tokens = r.randint(0, cfg.vocab_size,
+                       (args.global_batch, cfg.max_len)).astype(np.int32)
+    labels = (tokens[:, 0] % 2).astype(np.int32)
+    batch = {"tokens": tokens, "label": labels}
+    dt, _ = time_steps(step, state, batch, steps=args.steps)
+    report("bert_base_tensor_parallel_throughput",
+           args.global_batch * args.steps / dt, "sequences/sec")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Judged config 5: GPT-2 124M, GPipe pipeline parallelism over the ``pipe``
+mesh axis (stage-sharded shard_map + ppermute microbatch schedule).
+
+Metric: tokens/sec (global). With one device the pipeline degenerates to a
+single stage (still the real schedule); use --fake-devices 8 --pipe 4 to
+exercise multi-stage on CPU."""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.common import device_setup, report, time_steps
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pipe", type=int, default=1)
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--microbatch-size", type=int, default=2)
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--small", action="store_true",
+                    help="4-layer toy geometry instead of full 124M")
+    ap.add_argument("--fake-devices", type=int, default=0)
+    args = ap.parse_args()
+
+    device_setup(args.fake_devices)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_guide_tpu.core.dist import initialize
+    from distributed_tensorflow_guide_tpu.core.mesh import (
+        MeshSpec,
+        axis_sizes,
+        build_mesh,
+    )
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        TransformerConfig,
+        gpt2_124m,
+    )
+    from distributed_tensorflow_guide_tpu.parallel.pipeline import PipelinedLM
+
+    initialize()
+    mesh = build_mesh(MeshSpec(data=-1, pipe=args.pipe))
+    sizes = axis_sizes(mesh)
+    if args.small:
+        cfg = TransformerConfig(
+            vocab_size=1024, num_layers=4, num_heads=4, d_model=256,
+            d_ff=1024, max_len=args.seq_len, causal=True, dtype=jnp.float32)
+    else:
+        cfg = gpt2_124m(remat=True)
+        cfg = type(cfg)(**{**cfg.__dict__, "max_len": args.seq_len})
+    pp = PipelinedLM(mesh, cfg, num_microbatches=args.microbatches)
+    params = pp.init_params(jax.random.PRNGKey(0))
+    tx = optax.adam(3e-4)
+    opt_state = pp.init_opt_state(tx, params)
+    step = pp.make_train_step(tx, params)
+
+    global_batch = args.microbatches * args.microbatch_size * sizes["data"]
+    r = np.random.RandomState(0)
+    tokens = r.randint(0, cfg.vocab_size,
+                       (global_batch, cfg.max_len)).astype(np.int32)
+
+    # Adapt the 3-ary pipeline step to time_steps' (state, batch) shape.
+    def step2(st, b):
+        o, p, m = step(*st, b)
+        return (o, p), m
+
+    dt, _ = time_steps(step2, (opt_state, params), tokens, steps=args.steps)
+
+    report("gpt2_124m_pipeline_throughput",
+           global_batch * cfg.max_len * args.steps / dt, "tokens/sec")
+
+
+if __name__ == "__main__":
+    main()

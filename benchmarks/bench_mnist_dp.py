@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Judged config 1: MNIST CNN, synchronous data parallelism (the
+MirroredStrategy equivalent, tensorflow/python/distribute/mirrored_strategy.py:200).
+
+Prints one JSON line; metric is global images/sec (no published reference
+baseline exists — the guide never benchmarked, BASELINE.md)."""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.common import device_setup, report, time_steps
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--global-batch", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--fake-devices", type=int, default=0)
+    args = ap.parse_args()
+
+    device_setup(args.fake_devices)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax.training import train_state
+
+    from distributed_tensorflow_guide_tpu.core.dist import initialize
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.models.mnist_cnn import (
+        MNISTCNN,
+        make_loss_fn,
+    )
+    from distributed_tensorflow_guide_tpu.parallel.data_parallel import (
+        DataParallel,
+    )
+
+    initialize()
+    mesh = build_mesh(MeshSpec(data=-1))
+    dp = DataParallel(mesh)
+    model = MNISTCNN()
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    state = dp.replicate(train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.05)))
+    step = dp.make_train_step(make_loss_fn(model))
+
+    r = np.random.RandomState(0)
+    batch = dp.shard_batch({
+        "image": r.randn(args.global_batch, 28, 28, 1).astype(np.float32),
+        "label": r.randint(0, 10, args.global_batch).astype(np.int32),
+    })
+    dt, _ = time_steps(step, state, batch, steps=args.steps)
+    report("mnist_cnn_sync_dp_throughput",
+           args.global_batch * args.steps / dt, "images/sec")
+
+
+if __name__ == "__main__":
+    main()

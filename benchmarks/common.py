@@ -1,0 +1,104 @@
+"""Shared benchmark harness: honest timing + the one-JSON-line contract.
+
+Every benchmark in this directory prints exactly ONE JSON line
+``{"metric", "value", "unit", "vs_baseline"}`` — the same contract as the
+repo-root ``bench.py`` (the driver's flagship). ``vs_baseline`` is measured
+against a per-config reference constant where a meaningful one exists
+(A100-class hardware for the judged configs) and ``null`` otherwise.
+
+Timing is closed by materializing a host scalar that data-depends on the
+final step: ``jax.block_until_ready`` alone does not reliably fence
+execution on every PJRT transport (measured on the axon tunnel: readiness
+acked ~25x before compute finished), while a value fetch cannot complete
+early. All steps chain through the carried state, so fetching the last
+step's metric bounds the whole run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable
+
+
+def device_setup(fake_devices: int = 0) -> None:
+    """Configure devices + compilation cache (call before any other jax use).
+
+    With ``fake_devices``: force N virtual CPU devices — env + config both
+    needed, because the axon PJRT plugin re-asserts its platform during
+    ``import jax``. Real-device runs additionally get the persistent
+    compilation cache; fake-CPU runs deliberately do not (AOT CPU code cached
+    on a different machine can SIGILL on feature mismatch).
+    """
+    if fake_devices:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if fake_devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", fake_devices)
+    else:
+        setup_cache()
+
+
+def setup_cache() -> None:
+    """Persistent XLA compilation cache (cold compiles are slow over the
+    tunnel; warm runs — including the driver's — reuse it)."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.expanduser("~/.cache/dtg_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def fence(state: Any, metrics: dict | None, fence_key: str = "loss") -> None:
+    """Force completion of everything the last step produced.
+
+    Two host fetches: the metric scalar (forward pass) and a sum over the
+    first array leaf of ``state`` — the latter data-depends on the gradient /
+    optimizer update, which the loss alone does not.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if metrics is not None:
+        float(metrics[fence_key])
+    leaves = [l for l in jax.tree.leaves(state) if hasattr(l, "dtype")]
+    if leaves:
+        float(jnp.sum(leaves[0].astype(jnp.float32)))
+
+
+def time_steps(
+    step: Callable[[Any, Any], tuple[Any, dict]],
+    state: Any,
+    batch: Any,
+    *,
+    warmup: int = 3,
+    steps: int = 20,
+    fence_key: str = "loss",
+) -> tuple[float, Any]:
+    """Run ``state, metrics = step(state, batch)`` ``steps`` times and return
+    (seconds, final_state), closing the timed region with :func:`fence`."""
+    metrics = None
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    fence(state, metrics, fence_key)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    fence(state, metrics, fence_key)
+    return time.perf_counter() - t0, state
+
+
+def report(metric: str, value: float, unit: str,
+           baseline: float | None = None) -> None:
+    """Print the single JSON result line."""
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": unit,
+        "vs_baseline": round(value / baseline, 3) if baseline else None,
+    }))

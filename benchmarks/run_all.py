@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Run the full judged-config benchmark suite; one JSON line per config.
+
+Each bench runs in its own process (separate XLA runtime, honest timing).
+
+    python benchmarks/run_all.py            # real numbers on the local chip
+    python benchmarks/run_all.py --smoke    # tiny configs on 8 fake CPU
+                                            # devices — schema/liveness check
+
+Any other flags are forwarded to every bench verbatim."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+BENCHES = [
+    "bench_mnist_dp.py",      # config 1
+    "bench_resnet50_dp.py",   # config 2 (the flagship bench.py)
+    "bench_bert_tp.py",       # config 3
+    "bench_wide_deep.py",     # config 4
+    "bench_gpt2_pp.py",       # config 5
+]
+
+# Tiny fake-device configs, small enough for CPU (also used by
+# tests/test_benchmarks.py). bench_resnet50_dp.py is excluded: it delegates
+# to the flag-less repo-root bench.py, which needs the real chip.
+SMOKE = {
+    "bench_mnist_dp.py":
+        ["--fake-devices", "8", "--global-batch", "64", "--steps", "3"],
+    "bench_bert_tp.py":
+        ["--fake-devices", "8", "--model-parallel", "4", "--layers", "2",
+         "--global-batch", "8", "--seq-len", "64", "--steps", "2"],
+    "bench_wide_deep.py":
+        ["--fake-devices", "8", "--global-batch", "64", "--steps", "3"],
+    "bench_gpt2_pp.py":
+        ["--fake-devices", "8", "--pipe", "2", "--small", "--microbatches",
+         "2", "--microbatch-size", "1", "--seq-len", "64", "--steps", "2"],
+}
+
+
+def main() -> int:
+    here = Path(__file__).resolve().parent
+    extra = sys.argv[1:]
+    smoke = "--smoke" in extra
+    if smoke:
+        extra = [a for a in extra if a != "--smoke"]
+    failed = []
+    for name in BENCHES:
+        if smoke:
+            if name not in SMOKE:
+                continue
+            args = SMOKE[name] + extra
+        else:
+            # bench.py (via the resnet delegator) takes no flags
+            args = [] if name == "bench_resnet50_dp.py" else extra
+        r = subprocess.run([sys.executable, str(here / name), *args])
+        if r.returncode != 0:
+            failed.append(name)
+    if failed:
+        print(f"FAILED: {failed}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

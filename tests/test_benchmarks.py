@@ -1,0 +1,39 @@
+"""Benchmark-suite smoke tests: every judged-config bench runs end to end on
+fake CPU devices and prints a well-formed JSON result line.
+
+(The numbers only mean something on the real chip; these tests pin the
+contract — the scripts stay runnable and the one-line JSON schema stays
+intact — which is what the driver and judge consume.)
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+BENCH = REPO / "benchmarks"
+
+from benchmarks.run_all import SMOKE  # noqa: E402  (one source of smoke cfgs)
+
+CASES = sorted(SMOKE.items())
+
+
+@pytest.mark.parametrize("script,args", CASES,
+                         ids=[c[0].removeprefix("bench_").removesuffix(".py")
+                              for c in CASES])
+def test_bench_smoke(script, args):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # benches set their own device counts
+    r = subprocess.run(
+        [sys.executable, str(BENCH / script), *args],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    line = r.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert set(result) == {"metric", "value", "unit", "vs_baseline"}
+    assert result["value"] > 0
