@@ -12,9 +12,7 @@ Prints exactly ONE JSON line:
 """
 
 import json
-import os
 import sys
-import time
 
 A100_IMAGES_PER_SEC_PER_GPU = 2770.0
 
@@ -22,13 +20,11 @@ A100_IMAGES_PER_SEC_PER_GPU = 2770.0
 def main() -> None:
     import jax
 
+    from benchmarks.common import setup_cache
+
     # Persistent compilation cache: ResNet-50 cold-compiles very slowly over
     # the axon tunnel; warm runs (including the driver's) reuse the cache.
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.expanduser("~/.cache/dtg_jax_cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    setup_cache()
     import jax.numpy as jnp
     import numpy as np
     import optax

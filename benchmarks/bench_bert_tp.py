@@ -44,9 +44,11 @@ def main() -> None:
 
     initialize()
     mesh = build_mesh(MeshSpec(data=-1, model=args.model_parallel))
-    cfg = bert_base(num_classes=2, dtype=jnp.bfloat16)
-    cfg = type(cfg)(**{**cfg.__dict__, "num_layers": args.layers,
-                       "max_len": args.seq_len})
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        bert_base(num_classes=2, dtype=jnp.bfloat16),
+        num_layers=args.layers, max_len=args.seq_len)
     model = Transformer(cfg)
     tp = TensorParallel(mesh)
 

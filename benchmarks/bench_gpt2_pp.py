@@ -24,6 +24,10 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--small", action="store_true",
                     help="4-layer toy geometry instead of full 124M")
+    ap.add_argument("--attn", choices=["auto", "dense", "flash"],
+                    default="auto",
+                    help="auto = dense below 1024 tokens, Pallas flash at "
+                         ">= 1024 (dense cannot compile there under remat)")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -51,10 +55,13 @@ def main() -> None:
     if args.small:
         cfg = TransformerConfig(
             vocab_size=1024, num_layers=4, num_heads=4, d_model=256,
-            d_ff=1024, max_len=args.seq_len, causal=True, dtype=jnp.float32)
+            d_ff=1024, max_len=args.seq_len, causal=True, dtype=jnp.float32,
+            attn_impl=args.attn)
     else:
-        cfg = gpt2_124m(remat=True)
-        cfg = type(cfg)(**{**cfg.__dict__, "max_len": args.seq_len})
+        import dataclasses
+
+        cfg = dataclasses.replace(gpt2_124m(remat=True, attn_impl=args.attn),
+                                  max_len=args.seq_len)
     pp = PipelinedLM(mesh, cfg, num_microbatches=args.microbatches)
     params = pp.init_params(jax.random.PRNGKey(0))
     tx = optax.adam(3e-4)

@@ -7,5 +7,9 @@ import sys
 from pathlib import Path
 
 if __name__ == "__main__":
-    sys.argv = [str(Path(__file__).resolve().parents[1] / "bench.py")]
+    repo = Path(__file__).resolve().parents[1]
+    # bench.py imports the package and benchmarks.common; runpy.run_path
+    # does not add anything to sys.path, so the repo root must go in here.
+    sys.path.insert(0, str(repo))
+    sys.argv = [str(repo / "bench.py")]
     runpy.run_path(sys.argv[0], run_name="__main__")

@@ -2,9 +2,10 @@
 
 Every benchmark in this directory prints exactly ONE JSON line
 ``{"metric", "value", "unit", "vs_baseline"}`` — the same contract as the
-repo-root ``bench.py`` (the driver's flagship). ``vs_baseline`` is measured
-against a per-config reference constant where a meaningful one exists
-(A100-class hardware for the judged configs) and ``null`` otherwise.
+repo-root ``bench.py`` (the driver's flagship). ``vs_baseline`` is ``null``
+for the suite benches: the reference published no numbers (BASELINE.md), and
+the only externally defined baseline constant (A100-class ResNet-50) belongs
+to the flagship ``bench.py``, which computes it itself.
 
 Timing is closed by materializing a host scalar that data-depends on the
 final step: ``jax.block_until_ready`` alone does not reliably fence
@@ -57,18 +58,50 @@ def setup_cache() -> None:
 def fence(state: Any, metrics: dict | None, fence_key: str = "loss") -> None:
     """Force completion of everything the last step produced.
 
-    Two host fetches: the metric scalar (forward pass) and a sum over the
-    first array leaf of ``state`` — the latter data-depends on the gradient /
-    optimizer update, which the loss alone does not.
+    Host fetches that data-depend on the metric scalar (forward pass) and on
+    the *tails* of the state's params / opt_state / full pytree — tensors
+    that depend on the gradient and optimizer update. Fencing the FIRST
+    state leaf is not enough (pytree order puts bare counters like
+    TrainState.step and optax's count first, and they don't depend on the
+    gradients); fencing EVERY leaf is not viable either (hundreds of eager
+    ops, each a transport roundtrip, or one jitted fence program whose
+    remote compile takes longer than the bench). A handful of eager fetches
+    is the workable middle.
     """
     import jax
-    import jax.numpy as jnp
+    import numpy as np
 
     if metrics is not None:
         float(metrics[fence_key])
-    leaves = [l for l in jax.tree.leaves(state) if hasattr(l, "dtype")]
-    if leaves:
-        float(jnp.sum(leaves[0].astype(jnp.float32)))
+
+    # device_get is a pure transfer — crucially it compiles NOTHING (an
+    # eager reduction here would remote-compile a new tiny executable per
+    # op, which on the axon tunnel costs ~30s each). Pull the smallest leaf
+    # of params and of opt_state: their buffers are written by the fused
+    # update at the end of the step program, so the transfer cannot
+    # complete before the backward/update work has run.
+    def smallest_leaf(tree):
+        import jax.numpy as jnp
+
+        ls = [l for l in jax.tree.leaves(tree) if hasattr(l, "dtype")]
+        # Exclude bare counters (int scalars like TrainState.step / optax's
+        # count): they are minimum-size but carry no data dependence on the
+        # gradient. Prefer the smallest real tensor (a bias / its moment).
+        good = [l for l in ls
+                if jnp.issubdtype(l.dtype, jnp.floating) and l.size > 1]
+        pick = good or ls
+        return min(pick, key=lambda l: l.size) if pick else None
+
+    targets = [
+        smallest_leaf(getattr(state, "params", None)),     # updated weights
+        smallest_leaf(getattr(state, "opt_state", None)),  # optimizer moments
+    ]
+    if all(t is None for t in targets):
+        targets = [smallest_leaf(state)]
+    for t in targets:
+        if t is not None:
+            np.asarray(jax.device_get(t))
+    jax.block_until_ready(state)
 
 
 def time_steps(

@@ -54,6 +54,19 @@ class TensorParallel:
     def init_params(self, model: nn.Module, rng, *sample_args):
         """Initialize with every param materialized directly into its shard
         layout (no host-side full copy — how 100B-param states fit)."""
+        # TP runs under pjit/GSPMD, which cannot partition the Pallas flash
+        # custom call; catch a flash-resolving config here with an actionable
+        # error instead of a cryptic partitioner failure at compile time.
+        cfg = getattr(model, "cfg", None)
+        if getattr(cfg, "resolved_attn_impl", None) == "flash":
+            raise ValueError(
+                "TensorParallel requires attn_impl='dense' (GSPMD cannot "
+                "partition the Pallas flash custom call under pjit); this "
+                f"config resolves to 'flash' (attn_impl={cfg.attn_impl!r}, "
+                f"causal={cfg.causal}, max_len={cfg.max_len}). Pin "
+                "attn_impl='dense', or use a shard_map strategy (DP/PP/SP) "
+                "for flash."
+            )
 
         def init_fn():
             return model.init(rng, *sample_args)

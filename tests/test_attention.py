@@ -136,3 +136,22 @@ def test_ring_attention_grads_match_dense():
     )(q, k, v)
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_attn_impl_auto_resolution():
+    import pytest
+
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        TransformerConfig,
+        bert_base,
+        gpt2_124m,
+    )
+
+    # causal long-context -> flash; everything else -> dense
+    assert gpt2_124m().resolved_attn_impl == "flash"       # causal, 1024
+    assert bert_base().resolved_attn_impl == "dense"       # bidirectional
+    short = TransformerConfig(max_len=512, causal=True)
+    assert short.resolved_attn_impl == "dense"
+    assert gpt2_124m(attn_impl="dense").resolved_attn_impl == "dense"
+    with pytest.raises(ValueError):
+        TransformerConfig(attn_impl="bogus")

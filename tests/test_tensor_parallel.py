@@ -125,3 +125,25 @@ def test_lm_head_variant_runs():
     b = {"tokens": rng.randint(0, 128, (8, 16)).astype(np.int32)}
     state, m = step(state, b)
     assert np.isfinite(float(m["loss"])) and float(m["perplexity"]) > 1
+
+
+def test_tp_rejects_flash_resolving_config():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        Transformer,
+        gpt2_124m,
+    )
+    from distributed_tensorflow_guide_tpu.parallel.tensor import TensorParallel
+
+    mesh = build_mesh(MeshSpec(data=-1, model=2))
+    tp = TensorParallel(mesh)
+    # causal + max_len 1024 resolves attn_impl 'auto' -> 'flash', which GSPMD
+    # cannot partition under pjit; init_params must fail fast and actionably.
+    model = Transformer(gpt2_124m(dtype=jnp.float32))
+    with pytest.raises(ValueError, match="dense"):
+        tp.init_params(model, jax.random.PRNGKey(0),
+                       jnp.zeros((1, 1024), jnp.int32))
