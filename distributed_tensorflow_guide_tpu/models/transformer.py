@@ -62,11 +62,18 @@ class TransformerConfig:
                 f"got {self.attn_impl!r}"
             )
 
-    @property
-    def resolved_attn_impl(self) -> str:
+    def resolve_attn_impl(self, seq_len: int | None = None) -> str:
+        """Resolve 'auto' against the actual (trace-time) sequence length;
+        falls back to ``max_len`` when none is given (the config-level upper
+        bound, used by e.g. the TensorParallel flash guard)."""
         if self.attn_impl != "auto":
             return self.attn_impl
-        return "flash" if (self.causal and self.max_len >= 1024) else "dense"
+        s = self.max_len if seq_len is None else seq_len
+        return "flash" if (self.causal and s >= 1024) else "dense"
+
+    @property
+    def resolved_attn_impl(self) -> str:
+        return self.resolve_attn_impl()
 
     @property
     def head_dim(self) -> int:
@@ -119,7 +126,7 @@ class MultiHeadAttention(nn.Module):
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
 
-        if cfg.resolved_attn_impl == "flash":
+        if cfg.resolve_attn_impl(x.shape[1]) == "flash":
             from distributed_tensorflow_guide_tpu.ops.flash_attention import (
                 flash_attention,
             )
