@@ -113,3 +113,129 @@ def test_resume_from_existing_checkpoint_dir(tmp_path):
     np.testing.assert_allclose(
         np.asarray(final["params"]), np.asarray(state["params"]), rtol=1e-6
     )
+
+
+# ---- multi-process elastic recovery ----------------------------------------
+# Run 1: 2-process DP training crashes abruptly (os._exit, like an OOM-kill)
+# after a checkpoint landed. Run 2: a fresh launch resumes from the latest
+# checkpoint and finishes. Final params must match an uninterrupted reference
+# — the reference's MonitoredTrainingSession restart-from-checkpoint story,
+# but actually tested, across real process boundaries.
+
+MP_TOTAL = 12
+MP_CKPT_EVERY = 4
+MP_CRASH_AFTER = 7  # > first checkpoint (4), before the next (8)
+
+
+def _mp_elastic_problem():
+    rng = np.random.RandomState(3)
+    gx = rng.randn(8, 4).astype(np.float32)
+    gw = np.arange(4, dtype=np.float32)
+    return gx, gx @ gw
+
+
+def _target_elastic_dp(ckpt_dir, crash_after):
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax.training import train_state
+
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.parallel.data_parallel import (
+        DataParallel,
+    )
+    from distributed_tensorflow_guide_tpu.train.checkpoint import (
+        Checkpointer,
+        CheckpointHook,
+    )
+    from distributed_tensorflow_guide_tpu.train.hooks import (
+        BaseHook,
+        StopAtStepHook,
+    )
+    from distributed_tensorflow_guide_tpu.train.loop import TrainLoop
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    dp = DataParallel(mesh)
+    gx, gy = _mp_elastic_problem()
+    per = len(gx) // jax.process_count()
+    lo = jax.process_index() * per
+
+    def make_batch(s):
+        # step-keyed deterministic stream: scale inputs by (1 + s/10)
+        f = 1.0 + s / 10.0
+        return {"x": gx[lo:lo + per] * f, "y": gy[lo:lo + per] * f}
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2), {}
+
+    state0 = dp.replicate(train_state.TrainState.create(
+        apply_fn=lambda v, x: x @ v["params"]["w"],
+        params={"w": jnp.zeros(4, jnp.float32)},
+        tx=optax.sgd(0.05),
+    ))
+    ckpt = Checkpointer(ckpt_dir, max_to_keep=2)
+    start = ckpt.latest_step() or 0
+    state = ckpt.restore(state0) if start else state0
+
+    class CrashHook(BaseHook):
+        def after_step(self, step, metrics):
+            if crash_after >= 0 and step + 1 == crash_after:
+                ckpt.wait()  # make the async checkpoint durable first
+                print("CRASHING", flush=True)
+                os._exit(1)  # abrupt, like a kill — no atexit barriers
+
+    loop = TrainLoop(
+        dp.make_train_step(loss_fn, donate=False),
+        state,
+        (dp.shard_batch(make_batch(s)) for s in range(start, 10_000)),
+        hooks=[CheckpointHook(ckpt, MP_CKPT_EVERY), CrashHook(),
+               StopAtStepHook(MP_TOTAL)],
+        start_step=start,
+    )
+    final = loop.run()
+    ckpt.close()
+    return {
+        "resumed_from": start,
+        "steps_done": loop.step,
+        "w": np.asarray(final.params["w"]).tolist(),
+    }
+
+
+def test_multiprocess_crash_and_resume(tmp_path):
+    from distributed_tensorflow_guide_tpu.runtime.multiprocess import (
+        MultiProcessRunner,
+        run_multiprocess,
+    )
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    # run 1: crashes at step MP_CRASH_AFTER (after the step-4 checkpoint)
+    runner_results = MultiProcessRunner(
+        _target_elastic_dp, 2, args=(ckpt_dir, MP_CRASH_AFTER),
+        local_devices_per_process=2,
+    ).start().join(raise_on_error=False)
+    assert all(not r.ok for r in runner_results)
+    assert any("CRASHING" in r.stdout for r in runner_results)
+
+    # run 2: fresh processes resume from the durable checkpoint and finish
+    results = run_multiprocess(
+        _target_elastic_dp, 2, args=(ckpt_dir, -1),
+        local_devices_per_process=2,
+    )
+    for r in results:
+        assert r.result["resumed_from"] == MP_CKPT_EVERY
+        assert r.result["steps_done"] == MP_TOTAL
+
+    # parity with an uninterrupted single-process run of the same schedule
+    gx, gy = _mp_elastic_problem()
+    w = np.zeros(4, np.float32)
+    for s in range(MP_TOTAL):
+        f = 1.0 + s / 10.0
+        x, y = gx * f, gy * f
+        pred = x @ w
+        w = w - 0.05 * (2.0 / len(x)) * x.T @ (pred - y)
+    for r in results:
+        assert r.result["w"] == pytest.approx(w.tolist(), rel=1e-4)
