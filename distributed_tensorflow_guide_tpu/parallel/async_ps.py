@@ -46,6 +46,9 @@ from jax.sharding import PartitionSpec as P
 
 import distributed_tensorflow_guide_tpu.collectives as cc
 from distributed_tensorflow_guide_tpu.core.mesh import axis_sizes
+from distributed_tensorflow_guide_tpu.parallel.grad_accum import (
+    accumulate_grads,
+)
 
 LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
 
@@ -211,16 +214,9 @@ class AccumulatedAdaptive(_Strategy):
 
     def make_train_step(self, loss_fn: LossFn, *, donate: bool = True):
         def sm_step(state, batches):
-            zeros = jax.tree.map(jnp.zeros_like, state.params)
-
-            def inner(g_acc, sub):
-                (loss, _), g = jax.value_and_grad(loss_fn, has_aux=True)(
-                    state.params, sub
-                )
-                return jax.tree.map(jnp.add, g_acc, g), loss
-
-            g_acc, losses = lax.scan(inner, zeros, batches)
-            g = jax.tree.map(lambda a: a / self.accum_steps, g_acc)
+            g, (losses, _) = accumulate_grads(
+                loss_fn, state.params, batches, self.accum_steps
+            )
             g = cc.pmean(g, self.axis)
             state = state.apply_gradients(grads=g)
             return state, {"loss": cc.pmean(losses.mean(), self.axis)}

@@ -24,11 +24,15 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 import distributed_tensorflow_guide_tpu.collectives as cc
 from distributed_tensorflow_guide_tpu.core.mesh import axis_sizes
+from distributed_tensorflow_guide_tpu.parallel.grad_accum import (
+    accumulate_grads,
+)
 
 # loss_fn(params, batch) -> (scalar loss, dict of scalar metrics)
 LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
@@ -84,19 +88,41 @@ class DataParallel:
     def _pmean_metrics(self, mets: dict) -> dict:
         return {k: cc.pmean(v, self.axis) for k, v in mets.items()}
 
-    def make_train_step(self, loss_fn: LossFn, *, donate: bool = True):
+    def make_train_step(self, loss_fn: LossFn, *, donate: bool = True,
+                        accum_steps: int = 1):
         """Compile ``(state, batch) -> (state, metrics)``.
 
         ``state`` is a flax TrainState (replicated); ``batch`` a pytree
         sharded on its leading axis. Gradients are explicitly pmean-ed: the
         update is bit-identical on every device, which is what keeps replicas
         in lockstep without ever broadcasting parameters.
+
+        ``accum_steps > 1`` splits each device's shard into that many
+        microbatches and accumulates gradients over a ``lax.scan`` before the
+        single pmean + update — the DOWNPOUR 'fetch_period' knob reborn as a
+        memory knob: identical numerics to the full batch (mean-of-means over
+        equal microbatches), activation memory divided by ``accum_steps``,
+        and still exactly one collective per step. The per-device shard
+        length must divide by ``accum_steps``.
         """
 
         def sm_step(state, batch):
-            (loss, mets), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params, batch
-            )
+            if accum_steps == 1:
+                (loss, mets), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(state.params, batch)
+            else:
+                micro = jax.tree.map(
+                    lambda x: x.reshape(
+                        accum_steps, x.shape[0] // accum_steps, *x.shape[1:]
+                    ),
+                    batch,
+                )
+                grads, (losses, metas) = accumulate_grads(
+                    loss_fn, state.params, micro, accum_steps
+                )
+                loss = jnp.mean(losses)
+                mets = jax.tree.map(jnp.mean, metas)
             grads = cc.pmean(grads, self.axis)
             state = state.apply_gradients(grads=grads)
             return state, self._pmean_metrics({"loss": loss, **mets})

@@ -1,0 +1,39 @@
+"""Shared gradient-accumulation core.
+
+One implementation of accumulate-over-``lax.scan`` used by both the sync-DP
+``accum_steps`` knob (data_parallel.py) and the ADAG-descendant
+``AccumulatedAdaptive`` strategy (async_ps.py) — the numerics (mean of
+per-microbatch mean-gradients over equal microbatches == full-batch
+gradient) must stay identical in both, so they share this function.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# loss_fn(params, microbatch) -> (scalar loss, dict of scalar metrics)
+LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
+
+
+def accumulate_grads(loss_fn: LossFn, params: Any, microbatches: Any,
+                     accum_steps: int):
+    """Mean gradient over stacked microbatches, activations freed per micro.
+
+    ``microbatches``: pytree whose leaves lead with ``accum_steps``. Returns
+    ``(mean_grads, (losses, metrics))`` with per-microbatch stacked aux
+    (shape ``(accum_steps,)`` per scalar).
+    """
+    zeros = jax.tree.map(jnp.zeros_like, params)
+
+    def body(acc, mb):
+        (loss, mets), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, mb
+        )
+        return jax.tree.map(jnp.add, acc, g), (loss, mets)
+
+    summed, aux = lax.scan(body, zeros, microbatches)
+    return jax.tree.map(lambda g: g / accum_steps, summed), aux

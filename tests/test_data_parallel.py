@@ -108,3 +108,46 @@ def test_eval_step(batches):
     ev = dp.make_eval_step(metric_fn)
     m = ev(dp.replicate(state), dp.shard_batch(batches[0]))
     assert 0.0 <= float(m["accuracy"]) <= 1.0
+
+
+def test_grad_accumulation_matches_full_batch(mesh8):
+    """accum_steps=4 must produce the same trajectory as the plain step on
+    the identical global batch (mean-of-means over equal microbatches)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax.training import train_state
+
+    from distributed_tensorflow_guide_tpu.parallel.data_parallel import (
+        DataParallel,
+    )
+
+    dp = DataParallel(mesh8)
+    rng = np.random.RandomState(7)
+    gx = rng.randn(64, 3).astype(np.float32)
+    gw = np.array([1.0, -2.0, 0.5], np.float32)
+    gy = gx @ gw
+
+    def make_state():
+        return dp.replicate(train_state.TrainState.create(
+            apply_fn=lambda v, x: x @ v["params"]["w"],
+            params={"w": jnp.zeros(3, jnp.float32)},
+            tx=optax.sgd(0.1),
+        ))
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2), {}
+
+    batch = dp.shard_batch({"x": gx, "y": gy})
+    plain = dp.make_train_step(loss_fn, donate=False)
+    accum = dp.make_train_step(loss_fn, donate=False, accum_steps=4)
+
+    s1, s4 = make_state(), make_state()
+    for _ in range(5):
+        s1, m1 = plain(s1, batch)
+        s4, m4 = accum(s4, batch)
+    np.testing.assert_allclose(np.asarray(s4.params["w"]),
+                               np.asarray(s1.params["w"]), rtol=1e-5)
+    assert float(m4["loss"]) == pytest.approx(float(m1["loss"]), rel=1e-5)
