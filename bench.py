@@ -9,15 +9,49 @@ class numbers); vs_baseline >= 1.0 means step-time parity per chip.
 
 Prints exactly ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Robustness contract (round 2): the axon TPU backend is flaky — round 1's
+driver capture died with ``UNAVAILABLE: TPU backend setup/compile error``
+and a bare ``jax.devices()`` was observed to hang >120 s. A hang inside the
+PJRT C API cannot be interrupted from a thread, so the only reliable
+watchdog is a child process with a kill timeout. This file is therefore an
+orchestrator + worker in one:
+
+  * default (no args): orchestrate.  Up to ``MAX_ATTEMPTS`` rounds of
+    [cheap backend probe -> full bench], each in a subprocess with a hard
+    timeout, with backoff between failures.  Re-print the worker's JSON
+    line on success (rc 0); on final failure print ONE diagnostic JSON
+    line and exit 1 fast.
+  * ``--probe``: import jax, list devices, print count.  Bounded by the
+    parent's timeout.
+  * ``--run``: the actual benchmark (round 1's main()).
 """
 
+from __future__ import annotations
+
 import json
+import os
+import subprocess
 import sys
+import time
 
 A100_IMAGES_PER_SEC_PER_GPU = 2770.0
 
+MAX_ATTEMPTS = int(os.environ.get("BENCH_MAX_ATTEMPTS", "4"))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+RUN_TIMEOUT_S = float(os.environ.get("BENCH_RUN_TIMEOUT", "1500"))
+BACKOFF_S = (15, 30, 60)       # sleep between attempts i and i+1
 
-def main() -> None:
+
+def probe() -> None:
+    """Child-process backend probe: can jax see an accelerator at all?"""
+    import jax
+
+    devs = jax.devices()
+    print(f"probe-ok {len(devs)} {devs[0].platform}")
+
+
+def run_bench() -> None:
     import jax
 
     from benchmarks.common import setup_cache
@@ -94,6 +128,88 @@ def main() -> None:
             }
         )
     )
+
+
+def _child(arg: str, timeout: float) -> tuple[int | str, str]:
+    """Run ``python bench.py <arg>`` in a fresh process with a hard timeout.
+
+    Returns (returncode | "timeout", combined tail of output).  A fresh
+    process per attempt matters: a poisoned PJRT client in this process
+    would make every retry fail the same way.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), arg],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            timeout=timeout,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        return proc.returncode, proc.stdout
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return "timeout", out
+
+
+def _extract_json_line(out: str) -> dict | None:
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if {"metric", "value", "unit"} <= d.keys():
+                return d
+    return None
+
+
+def orchestrate() -> int:
+    t_start = time.time()
+    failures: list[str] = []
+    for attempt in range(MAX_ATTEMPTS):
+        if attempt:
+            time.sleep(BACKOFF_S[min(attempt - 1, len(BACKOFF_S) - 1)])
+        rc, out = _child("--probe", PROBE_TIMEOUT_S)
+        if rc != 0 or "probe-ok" not in out:
+            failures.append(f"attempt {attempt + 1} probe rc={rc}: "
+                            + " | ".join(out.strip().splitlines()[-2:]))
+            print(f"[bench] probe failed (attempt {attempt + 1}/{MAX_ATTEMPTS},"
+                  f" rc={rc}); backing off", file=sys.stderr)
+            continue
+        rc, out = _child("--run", RUN_TIMEOUT_S)
+        result = _extract_json_line(out) if rc == 0 else None
+        if result is not None:
+            print(json.dumps(result))
+            return 0
+        failures.append(f"attempt {attempt + 1} run rc={rc}: "
+                        + " | ".join(out.strip().splitlines()[-3:]))
+        print(f"[bench] run failed (attempt {attempt + 1}/{MAX_ATTEMPTS},"
+              f" rc={rc}); backing off", file=sys.stderr)
+    # Final failure: one diagnostic JSON line, nonzero exit, no hang.
+    print(json.dumps({
+        "metric": "resnet50_synthetic_imagenet_throughput",
+        "value": None,
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "error": "TPU backend unavailable after "
+                 f"{MAX_ATTEMPTS} attempts in {time.time() - t_start:.0f}s",
+        "attempts": failures[-MAX_ATTEMPTS:],
+    }))
+    return 1
+
+
+def main() -> int:
+    if "--probe" in sys.argv:
+        probe()
+        return 0
+    if "--run" in sys.argv:
+        run_bench()
+        return 0
+    return orchestrate()
 
 
 if __name__ == "__main__":

@@ -98,12 +98,26 @@ def initialize(config: DistConfig | None = None) -> None:
     # no-ops (config.update raises once backends are live), and an explicit
     # config keeps its no-env-leakage guarantee (comment above).
     if not explicit:
+        # config.update raises RuntimeError once any backend is live (e.g.
+        # user code touched jax.devices() before calling initialize()). Skip
+        # updates that already match, and turn the remaining failure into an
+        # actionable message instead of a bare RuntimeError.
         plat = os.environ.get("JAX_PLATFORMS")
-        if plat:
-            jax.config.update("jax_platforms", plat)
         ndev = os.environ.get("JAX_NUM_CPU_DEVICES")
-        if ndev:
-            jax.config.update("jax_num_cpu_devices", int(ndev))
+        try:
+            if plat and jax.config.jax_platforms != plat:
+                jax.config.update("jax_platforms", plat)
+            if ndev and jax.config.jax_num_cpu_devices != int(ndev):
+                jax.config.update("jax_num_cpu_devices", int(ndev))
+        except RuntimeError as e:
+            raise RuntimeError(
+                "initialize() must run before any JAX backend is used: the "
+                "environment requests JAX_PLATFORMS/JAX_NUM_CPU_DEVICES "
+                "settings that cannot be applied after jax.devices() (or any "
+                "computation) has initialized a backend. Call "
+                "distributed_tensorflow_guide_tpu.core.dist.initialize() "
+                "first, or clear those env vars."
+            ) from e
     kwargs = {}
     if coord is not None:
         kwargs["coordinator_address"] = coord

@@ -123,25 +123,6 @@ def main(argv: list[str] | None = None) -> int:
         f"cmd: {' '.join(base_cmd)}",
         flush=True,
     )
-    for pid in range(ns.num_processes):
-        proc = subprocess.Popen(
-            base_cmd,
-            env=_child_env(ns, coordinator, pid),
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-        )
-        log_file = (
-            open(ns.log_dir / f"p{pid}.log", "w")
-            if ns.log_dir is not None else None
-        )
-        logs.append(log_file)
-        t = threading.Thread(
-            target=_stream, args=(proc, pid, log_file, lock), daemon=True
-        )
-        t.start()
-        procs.append(proc)
-        threads.append(t)
-
     def _announce(bad: int, code: int) -> None:
         print(
             f"launch: process {bad} exited {code}; giving survivors "
@@ -151,6 +132,30 @@ def main(argv: list[str] | None = None) -> int:
 
     timed_out = False
     try:
+        # Spawning inside the try: if any open()/Popen in this loop fails
+        # (e.g. unwritable --log-dir entry), the finally below reaps the
+        # children already started instead of leaking them unsupervised.
+        # Log file is opened BEFORE its child so a failure leaves no extra
+        # untracked process.
+        for pid in range(ns.num_processes):
+            log_file = (
+                open(ns.log_dir / f"p{pid}.log", "w", encoding="utf-8")
+                if ns.log_dir is not None else None
+            )
+            logs.append(log_file)
+            proc = subprocess.Popen(
+                base_cmd,
+                env=_child_env(ns, coordinator, pid),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+            procs.append(proc)
+            t = threading.Thread(
+                target=_stream, args=(proc, pid, log_file, lock), daemon=True
+            )
+            t.start()
+            threads.append(t)
+
         timed_out = supervise(
             procs, timeout=ns.timeout, failure_grace=ns.failure_grace,
             on_first_failure=_announce,

@@ -112,6 +112,14 @@ class DataParallel:
                     loss_fn, has_aux=True
                 )(state.params, batch)
             else:
+                shard_len = jax.tree.leaves(batch)[0].shape[0]
+                if shard_len % accum_steps:
+                    raise ValueError(
+                        f"per-device batch shard of {shard_len} rows is not "
+                        f"divisible by accum_steps={accum_steps}; pick a "
+                        "global batch size that is a multiple of "
+                        f"data_parallel_size * accum_steps"
+                    )
                 micro = jax.tree.map(
                     lambda x: x.reshape(
                         accum_steps, x.shape[0] // accum_steps, *x.shape[1:]
