@@ -110,21 +110,38 @@ def run_bench() -> None:
     # transport (measured: the axon tunnel acks readiness early, inflating
     # throughput ~25x); a scalar fetch cannot complete before the compute it
     # depends on. The shared implementation lives in benchmarks/common.py.
+    #
+    # THREE independent timed windows, median reported: a single window on
+    # the axon tunnel cannot distinguish a transport hiccup from a real
+    # regression (round 2 recorded 2,067 vs round 1's 2,399 with no way to
+    # tell which was true). The spread is published in the JSON line so the
+    # driver's record is self-diagnosing.
     from benchmarks.common import time_steps
 
     n_steps = 20
+    n_trials = 3
+    trial_tput: list[float] = []
+    # One shared warmup (compile + cache), then per-trial windows with no
+    # further warmup — the steps chain through `state`, so every window
+    # starts from a fully-materialized steady state.
     dt, state = time_steps(step, state, batch, warmup=3, steps=n_steps)
+    trial_tput.append(global_batch * n_steps / dt / n_dev)
+    for _ in range(n_trials - 1):
+        dt, state = time_steps(step, state, batch, warmup=0, steps=n_steps)
+        trial_tput.append(global_batch * n_steps / dt / n_dev)
 
-    images_per_sec_per_chip = global_batch * n_steps / dt / n_dev
+    trial_tput.sort()
+    median = trial_tput[len(trial_tput) // 2]
+    spread_pct = 100.0 * (trial_tput[-1] - trial_tput[0]) / median
     print(
         json.dumps(
             {
                 "metric": "resnet50_synthetic_imagenet_throughput",
-                "value": round(images_per_sec_per_chip, 1),
+                "value": round(median, 1),
                 "unit": "images/sec/chip",
-                "vs_baseline": round(
-                    images_per_sec_per_chip / A100_IMAGES_PER_SEC_PER_GPU, 3
-                ),
+                "vs_baseline": round(median / A100_IMAGES_PER_SEC_PER_GPU, 3),
+                "trials": [round(t, 1) for t in trial_tput],
+                "spread_pct": round(spread_pct, 1),
             }
         )
     )

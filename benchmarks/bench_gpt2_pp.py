@@ -28,6 +28,9 @@ def main() -> None:
                     default="auto",
                     help="auto = dense below 1024 tokens, Pallas flash at "
                          ">= 1024 (dense cannot compile there under remat)")
+    ap.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe",
+                    help="microbatch schedule; 1f1b caps in-flight "
+                         "activations at the pipeline depth")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -62,7 +65,8 @@ def main() -> None:
 
         cfg = dataclasses.replace(gpt2_124m(remat=True, attn_impl=args.attn),
                                   max_len=args.seq_len)
-    pp = PipelinedLM(mesh, cfg, num_microbatches=args.microbatches)
+    pp = PipelinedLM(mesh, cfg, num_microbatches=args.microbatches,
+                     schedule=args.schedule)
     params = pp.init_params(jax.random.PRNGKey(0))
     tx = optax.adam(3e-4)
     opt_state = pp.init_opt_state(tx, params)

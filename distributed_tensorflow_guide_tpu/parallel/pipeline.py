@@ -48,6 +48,114 @@ from distributed_tensorflow_guide_tpu.models.transformer import (
 )
 
 
+def _make_1f1b_schedule(M: int, P: int):
+    """Static 1F1B schedule (Narayanan et al. 2019, PipeDream-flush).
+
+    Returns numpy tables driving the SPMD tick loop:
+      op[t, s] in {0 idle, 1 forward, 2 backward}; mb[t, s] = microbatch.
+      sa/sam[t, s]: stage s must store the activation that arrived this tick
+        (sent by s-1 at t-1) into slot ``sam % R``; sc/scm likewise for
+        cotangents from s+1.
+      R: ring-buffer depth (max in-flight microbatches + safety check that no
+        slot is overwritten before consumption).
+      T: total ticks.
+
+    Greedy simulation: each stage forwards through its warmup window
+    (min(P-s, M) microbatches), then strictly alternates backward-preferred /
+    forward — the classic 1F1B steady state that caps in-flight activations
+    at ~P-s instead of GPipe's M.
+    """
+    import numpy as np
+
+    next_f = [0] * P
+    next_b = [0] * P
+    f_tick = [[-1] * M for _ in range(P)]
+    b_tick = [[-1] * M for _ in range(P)]
+    op_rows: list[list[int]] = []
+    mb_rows: list[list[int]] = []
+    t = 0
+    max_inflight = 1
+    while any(next_b[s] < M for s in range(P)):
+        row_op = [0] * P
+        row_mb = [0] * P
+        for s in range(P):
+            cap = min(P - s, M)  # 1F1B in-flight bound for stage s
+            can_f = (
+                next_f[s] < M
+                and next_f[s] - next_b[s] < cap
+                and (s == 0 or 0 <= f_tick[s - 1][next_f[s]] < t)
+            )
+            can_b = next_b[s] < next_f[s] and (
+                s == P - 1 or 0 <= b_tick[s + 1][next_b[s]] < t
+            )
+            if s == P - 1 and can_b and not (0 <= f_tick[s][next_b[s]] < t):
+                can_b = False
+            in_warmup = next_f[s] < cap
+            if can_f and in_warmup:
+                row_op[s], row_mb[s] = 1, next_f[s]
+            elif can_b:
+                row_op[s], row_mb[s] = 2, next_b[s]
+            elif can_f:
+                row_op[s], row_mb[s] = 1, next_f[s]
+        for s in range(P):
+            if row_op[s] == 1:
+                f_tick[s][row_mb[s]] = t
+                next_f[s] += 1
+            elif row_op[s] == 2:
+                b_tick[s][row_mb[s]] = t
+                next_b[s] += 1
+            max_inflight = max(max_inflight, next_f[s] - next_b[s])
+        op_rows.append(row_op)
+        mb_rows.append(row_mb)
+        t += 1
+        if t > 6 * (M + P) + 16:
+            raise RuntimeError("1F1B schedule generation did not converge")
+    T = t
+    op = np.array(op_rows, np.int32)
+    mb = np.array(mb_rows, np.int32)
+
+    # receive bookkeeping: arrival at tick t is what the neighbor sent at t-1
+    sa = np.zeros((T, P), np.int32)
+    sam = np.zeros((T, P), np.int32)
+    sc = np.zeros((T, P), np.int32)
+    scm = np.zeros((T, P), np.int32)
+    for tt in range(1, T):
+        for s in range(P):
+            if s > 0 and op[tt - 1, s - 1] == 1:
+                sa[tt, s], sam[tt, s] = 1, mb[tt - 1, s - 1]
+            if s < P - 1 and op[tt - 1, s + 1] == 2:
+                sc[tt, s], scm[tt, s] = 1, mb[tt - 1, s + 1]
+
+    def slots_ok(R: int) -> bool:
+        """No buffer slot may be overwritten before its consumer runs."""
+        for s in range(P):
+            # act_buf: arrival (t from sa) .. consumption (F at stage s);
+            # resid:   store (F) .. consumption (B); cot_buf: arrival .. B.
+            intervals: dict[int, list[tuple[int, int]]] = {}
+
+            def add(slot, t0, t1):
+                intervals.setdefault(slot, []).append((t0, t1))
+
+            for m in range(M):
+                if s > 0:
+                    add(m % R, f_tick[s - 1][m] + 1, f_tick[s][m])
+                add((m % R) + R, f_tick[s][m], b_tick[s][m])  # resid
+                if s < P - 1:
+                    add((m % R) + 2 * R, b_tick[s + 1][m] + 1, b_tick[s][m])
+            for spans in intervals.values():
+                spans.sort()
+                for (a0, a1), (b0, _b1) in zip(spans, spans[1:]):
+                    if b0 <= a1:
+                        return False
+        return True
+
+    R = max_inflight
+    while not slots_ok(R):  # pragma: no cover - safety margin
+        R += 1
+    return {"op": op, "mb": mb, "sa": sa, "sam": sam, "sc": sc, "scm": scm,
+            "R": R, "T": T}
+
+
 class _Embedder(nn.Module):
     cfg: TransformerConfig
 
@@ -75,9 +183,12 @@ class PipelinedLM:
     """GPipe LM training over the ``pipe`` (× ``data``) mesh axes."""
 
     def __init__(self, mesh: Mesh, cfg: TransformerConfig,
-                 num_microbatches: int):
+                 num_microbatches: int, schedule: str = "gpipe"):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
         self.mesh = mesh
         self.cfg = cfg
+        self.schedule = schedule
         sizes = axis_sizes(mesh)
         self.n_stages = sizes["pipe"]
         self.n_data = sizes["data"]
@@ -139,11 +250,40 @@ class PipelinedLM:
         out, _ = lax.scan(body, x, stage_params)
         return out
 
+    def _embed_all(self, embed_params, tokens_mbs):
+        """Embed all M microbatches at once: (M, mb, S) -> (M, mb, S, D)."""
+        M, mb, S = tokens_mbs.shape
+        flat = tokens_mbs.reshape(M * mb, S)
+        e = self.embedder.apply({"params": embed_params}, flat)
+        return e.reshape(M, mb, S, self.cfg.d_model).astype(self.cfg.dtype)
+
+    def _mb_loss(self, head_params, x, toks):
+        """Head + next-token NLL for one microbatch's final activations.
+
+        The single definition shared by both schedules — gpipe and 1f1b are
+        contractually gradient-identical, so the loss math must not fork.
+        """
+        logits = self.head.apply({"params": head_params}, x)
+        logp = jax.nn.log_softmax(logits[:, :-1])
+        ll = jnp.take_along_axis(
+            logp, toks[:, 1:][..., None], axis=-1
+        )[..., 0]
+        return -jnp.mean(ll)
+
     def _pipeline_loss(self, params, tokens_mbs):
         """Per-device pipeline forward + LM loss.
 
         tokens_mbs: (M, mb, S) — this data-shard's microbatches.
         Returns mean next-token loss over all microbatches.
+
+        FLOP discipline (round-3 restructure): the embedder runs ONCE for all
+        M microbatches and only on stage 0; the head runs ONCE per microbatch
+        and only on the last stage. Both owner-only paths use ``lax.cond``,
+        which executes a single branch at runtime — non-owning stages pay
+        nothing. The tick loop itself contains only block compute + one
+        neighbor ppermute; completed last-stage activations are carried out
+        of the scan as its ys and consumed by a post-scan head loop (a scan
+        over microbatches, so logits memory stays at one microbatch).
         """
         cfg = self.cfg
         M, mb, S = tokens_mbs.shape
@@ -152,37 +292,41 @@ class PipelinedLM:
         stage_params = jax.tree.map(lambda x: x[0], params["stages"])
         fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
-        def tick(carry, t):
-            received, loss_sum = carry
+        embeds = lax.cond(
+            stage == 0,
+            lambda: self._embed_all(params["embed"], tokens_mbs),
+            lambda: jnp.zeros((M, mb, S, cfg.d_model), cfg.dtype),
+        )
+
+        def tick(received, t):
             # stage 0 injects microbatch t (clamped during drain ticks)
             inject_idx = jnp.clip(t, 0, M - 1)
-            toks_in = lax.dynamic_index_in_dim(
-                tokens_mbs, inject_idx, axis=0, keepdims=False
+            x_inject = lax.dynamic_index_in_dim(
+                embeds, inject_idx, axis=0, keepdims=False
             )
-            injected = self.embedder.apply({"params": params["embed"]}, toks_in)
-            x_in = jnp.where(stage == 0, injected, received)
+            x_in = jnp.where(stage == 0, x_inject, received)
             x_out = self._stage_apply(stage_params, x_in)
-
-            # last stage finishes microbatch m = t - (P-1)
-            m_idx = t - (n_stages - 1)
-            valid = jnp.logical_and(stage == n_stages - 1, m_idx >= 0)
-            toks_out = lax.dynamic_index_in_dim(
-                tokens_mbs, jnp.clip(m_idx, 0, M - 1), axis=0, keepdims=False
-            )
-            logits = self.head.apply({"params": params["head"]}, x_out)
-            logp = jax.nn.log_softmax(logits[:, :-1])
-            ll = jnp.take_along_axis(
-                logp, toks_out[:, 1:][..., None], axis=-1
-            )[..., 0]
-            mb_loss = -jnp.mean(ll)
-            loss_sum = loss_sum + jnp.where(valid, mb_loss, 0.0)
-
             received = cc.ppermute(x_out, "pipe", fwd)
-            return (received, loss_sum), None
+            return received, x_out
 
         x0 = jnp.zeros((mb, S, cfg.d_model), cfg.dtype)
-        (_, loss_sum), _ = lax.scan(
-            tick, (x0, jnp.float32(0.0)), jnp.arange(M + n_stages - 1)
+        _, taps = lax.scan(tick, x0, jnp.arange(M + n_stages - 1))
+        # On the last stage, tick t completes microbatch m = t-(P-1); the
+        # first P-1 ys are fill ticks on every stage.
+        taps = taps[n_stages - 1:]  # (M, mb, S, d_model)
+
+        def head_loss():
+            def body(acc, inp):
+                x, toks = inp
+                return acc + self._mb_loss(params["head"], x, toks), None
+
+            total, _ = lax.scan(
+                body, jnp.float32(0.0), (taps, tokens_mbs)
+            )
+            return total
+
+        loss_sum = lax.cond(
+            stage == n_stages - 1, head_loss, lambda: jnp.float32(0.0)
         )
         # LOCAL loss: nonzero only on the last stage. Do NOT psum here — the
         # transpose of psum under shard_map is another psum, which would
@@ -191,6 +335,172 @@ class PipelinedLM:
         # ppermute transposes (the backward pipeline). The caller psums the
         # VALUE for reporting.
         return loss_sum / M
+
+    # -- 1F1B schedule (manual VJP) -------------------------------------------
+    def _loss_and_grads_1f1b(self, params, tokens_mbs):
+        """Per-device 1F1B pipeline: ``(params, (M, mb, S)) -> (loss, grads)``.
+
+        GPipe (``_pipeline_loss`` + ``jax.grad``) runs all M forwards, then
+        all M backwards — activation residuals for every microbatch are live
+        at the peak. 1F1B interleaves: after a warmup of min(P-s, M)
+        forwards, each stage strictly alternates backward/forward, so at most
+        ~P microbatches are ever in flight and the residual ring buffer is
+        O(P), not O(M). The schedule is a STATIC table (``_make_1f1b_schedule``)
+        consumed as scan xs — no data-dependent control flow reaches XLA; the
+        per-tick op dispatch is one ``lax.switch``.
+
+        Backward here is hand-written (jax.vjp per tick) because autodiff
+        through the forward scan can only produce the all-forward-then-
+        all-backward order. Stage backward recomputes its forward from the
+        saved stage INPUT (per-stage remat — the 1F1B memory contract).
+        Collectives stay OUTSIDE the switch: every tick unconditionally
+        ppermutes one activation forward and one cotangent backward (zeros
+        when idle), so every device always participates.
+        """
+        cfg = self.cfg
+        M, mb, S = tokens_mbs.shape
+        P_ = self.n_stages
+        stage = lax.axis_index("pipe")
+        stage_params = jax.tree.map(lambda x: x[0], params["stages"])
+        fwd_perm = [(i, (i + 1) % P_) for i in range(P_)]
+        bwd_perm = [(i, (i - 1) % P_) for i in range(P_)]
+        sched = _make_1f1b_schedule(M, P_)
+        R = sched["R"]
+
+        embeds = lax.cond(
+            stage == 0,
+            lambda: self._embed_all(params["embed"], tokens_mbs),
+            lambda: jnp.zeros((M, mb, S, cfg.d_model), cfg.dtype),
+        )
+
+        def stage_fn(sp, x):
+            return self._stage_apply(sp, x)
+
+        def last_stage_loss(sp, hp, x, toks):
+            out = self._stage_apply(sp, x)
+            return self._mb_loss(hp, out, toks) / M  # total loss = sum_m this
+
+        f32 = jnp.float32
+        zero_g = {
+            "embed": jax.tree.map(lambda p: jnp.zeros(p.shape, f32),
+                                  params["embed"]),
+            "stage": jax.tree.map(lambda p: jnp.zeros(p.shape, f32),
+                                  stage_params),
+            "head": jax.tree.map(lambda p: jnp.zeros(p.shape, f32),
+                                 params["head"]),
+        }
+        buf = jnp.zeros((R, mb, S, cfg.d_model), cfg.dtype)
+        x_zero = jnp.zeros((mb, S, cfg.d_model), cfg.dtype)
+
+        def tick(carry, xs):
+            act_buf, cot_buf, resid_buf, act_in, cot_in, g_acc, loss_acc = carry
+            op_row, mb_row, sa_row, sam_row, sc_row, scm_row = xs
+            op = jnp.take(op_row, stage)
+            m = jnp.take(mb_row, stage)
+
+            # 1) land last tick's arrivals in their ring-buffer slots
+            def land(buf_, val, flag, slot):
+                cur = lax.dynamic_index_in_dim(buf_, slot, 0, keepdims=False)
+                new = jnp.where(flag.astype(bool), val, cur)
+                return lax.dynamic_update_index_in_dim(buf_, new, slot, 0)
+
+            act_buf = land(act_buf, act_in, jnp.take(sa_row, stage),
+                           jnp.take(sam_row, stage) % R)
+            cot_buf = land(cot_buf, cot_in, jnp.take(sc_row, stage),
+                           jnp.take(scm_row, stage) % R)
+
+            slot = m % R
+            toks = lax.dynamic_index_in_dim(
+                tokens_mbs, jnp.clip(m, 0, M - 1), axis=0, keepdims=False
+            )
+
+            # 2) this tick's op
+            def do_idle(resid_buf, g_acc, loss_acc):
+                return resid_buf, g_acc, loss_acc, x_zero, x_zero
+
+            def do_fwd(resid_buf, g_acc, loss_acc):
+                x_prev = lax.dynamic_index_in_dim(act_buf, slot, 0,
+                                                  keepdims=False)
+                x_emb = lax.dynamic_index_in_dim(
+                    embeds, jnp.clip(m, 0, M - 1), axis=0, keepdims=False
+                )
+                x_in = jnp.where(stage == 0, x_emb, x_prev)
+                resid_buf = lax.dynamic_update_index_in_dim(
+                    resid_buf, x_in, slot, 0
+                )
+                x_out = stage_fn(stage_params, x_in)
+                return resid_buf, g_acc, loss_acc, x_out, x_zero
+
+            def do_bwd(resid_buf, g_acc, loss_acc):
+                x_in = lax.dynamic_index_in_dim(resid_buf, slot, 0,
+                                                keepdims=False)
+
+                def last_branch():
+                    loss_m, vjp = jax.vjp(
+                        lambda sp, hp, x: last_stage_loss(sp, hp, x, toks),
+                        stage_params, params["head"], x_in,
+                    )
+                    d_sp, d_hp, dx = vjp(f32(1.0))
+                    return loss_m, d_sp, d_hp, dx
+
+                def mid_branch():
+                    g_out = lax.dynamic_index_in_dim(cot_buf, slot, 0,
+                                                     keepdims=False)
+                    _, vjp = jax.vjp(stage_fn, stage_params, x_in)
+                    d_sp, dx = vjp(g_out)
+                    return f32(0.0), d_sp, zero_g["head"], dx
+
+                loss_m, d_sp, d_hp, dx = lax.cond(
+                    stage == P_ - 1, last_branch, mid_branch
+                )
+
+                def embed_branch():
+                    _, evjp = jax.vjp(
+                        lambda ep: self.embedder.apply(
+                            {"params": ep}, toks
+                        ).astype(cfg.dtype),
+                        params["embed"],
+                    )
+                    (d_emb,) = evjp(dx)
+                    return jax.tree.map(lambda g: g.astype(f32), d_emb)
+
+                d_emb = lax.cond(
+                    stage == 0, embed_branch, lambda: zero_g["embed"]
+                )
+                g_acc = {
+                    "embed": jax.tree.map(jnp.add, g_acc["embed"], d_emb),
+                    "stage": jax.tree.map(
+                        lambda a, g: a + g.astype(f32), g_acc["stage"], d_sp
+                    ),
+                    "head": jax.tree.map(
+                        lambda a, g: a + g.astype(f32), g_acc["head"], d_hp
+                    ),
+                }
+                return resid_buf, g_acc, loss_acc + loss_m, x_zero, dx
+
+            resid_buf, g_acc, loss_acc, send_act, send_cot = lax.switch(
+                op, [do_idle, do_fwd, do_bwd], resid_buf, g_acc, loss_acc
+            )
+
+            # 3) unconditional neighbor exchange (zeros when idle)
+            act_in = cc.ppermute(send_act, "pipe", fwd_perm)
+            cot_in = cc.ppermute(send_cot, "pipe", bwd_perm)
+            return (act_buf, cot_buf, resid_buf, act_in, cot_in, g_acc,
+                    loss_acc), None
+
+        xs = tuple(
+            jnp.asarray(sched[k]) for k in ("op", "mb", "sa", "sam", "sc",
+                                            "scm")
+        )
+        (_, _, _, _, _, g_acc, loss_acc), _ = lax.scan(
+            tick, (buf, buf, buf, x_zero, x_zero, zero_g, f32(0.0)), xs
+        )
+        grads = {
+            "embed": g_acc["embed"],
+            "stages": jax.tree.map(lambda g: g[None], g_acc["stage"]),
+            "head": g_acc["head"],
+        }
+        return loss_acc, grads
 
     # -- compiled step --------------------------------------------------------
     def make_train_step(self, tx: optax.GradientTransformation, params,
@@ -203,9 +513,12 @@ class PipelinedLM:
 
         def sm_step(opt_state, params, tokens):
             mbs = tokens.reshape(M, tokens.shape[0] // M, tokens.shape[1])
-            local_loss, grads = jax.value_and_grad(self._pipeline_loss)(
-                params, mbs
-            )
+            if self.schedule == "1f1b":
+                local_loss, grads = self._loss_and_grads_1f1b(params, mbs)
+            else:
+                local_loss, grads = jax.value_and_grad(self._pipeline_loss)(
+                    params, mbs
+                )
             loss = cc.psum(local_loss, "pipe")  # value only; see _pipeline_loss
             # embed/head grads are nonzero only on their owning stage;
             # stage grads are per-stage (no pipe reduction needed)

@@ -1,0 +1,107 @@
+"""Schedule-aware matmul FLOP accounting from traced jaxprs.
+
+Why not ``compiled.cost_analysis()``: XLA's HLO cost analysis counts a loop
+body ONCE, ignoring trip counts — a pipeline that wastefully re-runs its LM
+head inside every scheduling tick reports the same "flops" as one that runs
+it once per microbatch (measured: identical numbers for schedules whose real
+work differs 7x). This module walks the *jaxpr* instead, multiplying
+``lax.scan`` bodies by their static trip count, so the number reflects the
+work as scheduled.
+
+Counts ``dot_general`` only — the MXU-relevant FLOPs that dominate every
+model here (elementwise work is bandwidth, not FLOPs, on TPU). Control-flow
+conventions:
+
+* ``scan``: body flops x trip count (the whole point).
+* ``cond``/``switch``/``platform_index``: runtime executes ONE branch; we
+  take the max — an upper bound that is exact when the expensive branch is
+  the one taken (e.g. a pipeline stage that owns the head).
+* ``while``: trip count is dynamic; body counted once (documented
+  undercount — none of the framework's hot paths use raw while_loop).
+* anything else carrying sub-jaxprs (pjit, remat, custom_vjp, shard_map):
+  summed.
+
+There is no reference equivalent: the reference has no benchmarks at all
+(SURVEY.md §6); this is part of the test/bench capability gap the TPU build
+fills (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+from jax.extend import core
+
+
+def _dot_general_flops(eqn) -> float:
+    lhs, rhs = (v.aval for v in eqn.invars[:2])
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    batch = math.prod(lhs.shape[i] for i in lb)
+    contract = math.prod(lhs.shape[i] for i in lc)
+    lhs_free = math.prod(
+        d for i, d in enumerate(lhs.shape) if i not in set(lb) | set(lc)
+    )
+    rhs_free = math.prod(
+        d for i, d in enumerate(rhs.shape) if i not in set(rb) | set(rc)
+    )
+    return 2.0 * batch * contract * lhs_free * rhs_free
+
+
+def _conv_flops(eqn) -> float:
+    # conv_general_dilated: 2 * out_spatial_elems * batch * Cout * Cin * prod(k)
+    lhs, rhs = (v.aval for v in eqn.invars[:2])
+    out = eqn.outvars[0].aval
+    dn = eqn.params["dimension_numbers"]
+    k_spatial = math.prod(rhs.shape[i] for i in dn.rhs_spec[2:])
+    c_in = rhs.shape[dn.rhs_spec[1]]
+    c_out = out.shape[dn.out_spec[1]]
+    batch = out.shape[dn.out_spec[0]]
+    out_spatial = math.prod(out.shape[i] for i in dn.out_spec[2:])
+    groups = eqn.params.get("feature_group_count", 1)
+    return 2.0 * batch * out_spatial * c_out * c_in * k_spatial / groups
+
+
+def jaxpr_matmul_flops(jaxpr: Any) -> float:
+    """Total dot_general+conv FLOPs of a (Closed)Jaxpr, scan-trip-aware."""
+    if isinstance(jaxpr, core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    total = 0.0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            total += _dot_general_flops(eqn)
+        elif name == "conv_general_dilated":
+            total += _conv_flops(eqn)
+        elif name == "scan":
+            total += eqn.params["length"] * jaxpr_matmul_flops(
+                eqn.params["jaxpr"]
+            )
+        elif name in ("cond", "switch"):
+            total += max(
+                jaxpr_matmul_flops(b) for b in eqn.params["branches"]
+            )
+        elif name == "while":
+            total += jaxpr_matmul_flops(eqn.params["body_jaxpr"])
+        else:
+            for v in eqn.params.values():
+                if isinstance(v, (core.Jaxpr, core.ClosedJaxpr)):
+                    total += jaxpr_matmul_flops(v)
+                elif isinstance(v, (tuple, list)):
+                    total += sum(
+                        jaxpr_matmul_flops(x) for x in v
+                        if isinstance(x, (core.Jaxpr, core.ClosedJaxpr))
+                    )
+    return total
+
+
+def traced_matmul_flops(fn, *args, **kwargs) -> float:
+    """Per-device matmul FLOPs of ``fn(*args, **kwargs)`` as scheduled.
+
+    Under ``shard_map`` the jaxpr is the per-device program, so the result is
+    per-device work — multiply by the mesh size for machine totals.
+    """
+    return jaxpr_matmul_flops(
+        jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+    )

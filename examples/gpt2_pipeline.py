@@ -34,6 +34,7 @@ def main() -> None:
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--full-gpt2", action="store_true",
                     help="use the real GPT-2 124M geometry")
+    ap.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -72,7 +73,8 @@ def main() -> None:
             d_ff=4 * args.d_model, max_len=args.seq_len, causal=True,
             dtype=jnp.float32,
         )
-    pp = PipelinedLM(mesh, cfg, num_microbatches=args.microbatches)
+    pp = PipelinedLM(mesh, cfg, num_microbatches=args.microbatches,
+                     schedule=args.schedule)
     params = pp.init_params(jax.random.PRNGKey(0))
     n_params = sum(p.size for p in jax.tree.leaves(params))
     tx = optax.adam(args.lr)

@@ -67,11 +67,14 @@ def test_bench_orchestrator_fails_fast_with_diagnostic_line():
     env = dict(os.environ)
     env.update(
         BENCH_MAX_ATTEMPTS="1",
-        BENCH_PROBE_TIMEOUT="3",
-        # Guarantee the probe child cannot succeed quickly even if the TPU
-        # tunnel happens to be healthy: an unimportable sitecustomize isn't
-        # reliable, so just rely on the 3s timeout (jax import alone exceeds
-        # it) — the point is the orchestrator's failure path, not the probe.
+        BENCH_PROBE_TIMEOUT="30",
+        BENCH_RUN_TIMEOUT="30",
+        # Deterministic probe failure: jax.devices() raises on an unknown
+        # platform name, no matter how healthy the real backend is. (The
+        # previous version relied on a 3s timeout beating `import jax`,
+        # which a warm page cache could win — then the full bench ran and
+        # blew the outer 120s timeout.)
+        JAX_PLATFORMS="no_such_platform",
     )
     r = subprocess.run(
         [sys.executable, str(REPO / "bench.py")],

@@ -46,11 +46,12 @@ def _reference_loss(pp, params, tokens):
     return -jnp.mean(ll)
 
 
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 @pytest.mark.parametrize("n_pipe,n_data", [(4, 1), (2, 2)])
-def test_pipeline_matches_unpipelined(n_pipe, n_data):
+def test_pipeline_matches_unpipelined(n_pipe, n_data, schedule):
     mesh = build_mesh(MeshSpec(data=n_data, pipe=n_pipe, model=8 // (n_pipe * n_data)))
     M = 4  # microbatches
-    pp = PipelinedLM(mesh, CFG, num_microbatches=M)
+    pp = PipelinedLM(mesh, CFG, num_microbatches=M, schedule=schedule)
     params = pp.init_params(jax.random.PRNGKey(0))
     tx = optax.sgd(0.1)
     opt_state = pp.init_opt_state(tx, params)
@@ -92,6 +93,106 @@ def test_pipeline_training_learns():
         opt_state, params, m = step(opt_state, params, tokens)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+@pytest.mark.parametrize("M,P", [(4, 4), (8, 4), (2, 4), (1, 2), (6, 2), (8, 8)])
+def test_1f1b_schedule_invariants(M, P):
+    from distributed_tensorflow_guide_tpu.parallel.pipeline import (
+        _make_1f1b_schedule,
+    )
+
+    s = _make_1f1b_schedule(M, P)
+    op, mb = s["op"], s["mb"]
+    f_tick = {}
+    b_tick = {}
+    for t in range(s["T"]):
+        for st in range(P):
+            if op[t, st] == 1:
+                f_tick[(st, mb[t, st])] = t
+            elif op[t, st] == 2:
+                b_tick[(st, mb[t, st])] = t
+    # every microbatch forwarded and backwarded exactly once per stage
+    assert set(f_tick) == {(st, m) for st in range(P) for m in range(M)}
+    assert set(b_tick) == set(f_tick)
+    inflight_max = 0
+    for st in range(P):
+        for m in range(M):
+            # dataflow: activation arrives one tick after upstream F
+            if st > 0:
+                assert f_tick[(st, m)] > f_tick[(st - 1, m)]
+            if st < P - 1:
+                assert b_tick[(st, m)] > b_tick[(st + 1, m)]
+            assert b_tick[(st, m)] > f_tick[(st, m)]
+        inflight = max(
+            sum(1 for m in range(M)
+                if f_tick[(st, m)] <= t < b_tick[(st, m)])
+            for t in range(s["T"])
+        )
+        inflight_max = max(inflight_max, inflight)
+    # the 1F1B contract: in-flight bounded by pipeline depth, not M
+    assert inflight_max <= min(P + 1, M), (inflight_max, M, P)
+    assert s["R"] >= inflight_max
+
+
+def test_pipeline_flop_discipline():
+    """The round-2 verdict's structural-waste finding, pinned as a test.
+
+    Per-device traced matmul FLOPs of the GPipe step must stay close to the
+    unpipelined oracle's. With this head-dominated config (vocab 2048, M=4,
+    P=4) the pre-restructure code — embedder + full LM head applied EVERY
+    tick on EVERY stage, discarded by masking — puts head+embed at
+    (M+P-1)/M = 1.75x the oracle and totals ~1.6x; the restructured
+    schedule (head once per microbatch on the owning stage, embed once on
+    stage 0) totals ~0.8x (head/embed 1.0x, blocks (M+P-1)/(M*P) = 0.44x).
+    The 1.1 threshold cleanly separates the two regimes — do not raise it
+    without re-deriving these ratios. ``cost_analysis`` cannot see any of
+    this (it counts scan bodies once); ``traced_matmul_flops`` multiplies
+    trip counts.
+    """
+    from distributed_tensorflow_guide_tpu.utils.flop_accounting import (
+        traced_matmul_flops,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=2048, num_layers=4, num_heads=2, d_model=32, d_ff=64,
+        max_len=16, causal=True, dtype=jnp.float32,
+    )
+    mesh = build_mesh(MeshSpec(data=2, pipe=4, model=1))
+    M = 4
+    pp = PipelinedLM(mesh, cfg, num_microbatches=M)
+    params = pp.init_params(jax.random.PRNGKey(0))
+    tx = optax.sgd(0.1)
+    opt_state = pp.init_opt_state(tx, params)
+    step = pp.make_train_step(tx, params, donate=False)
+    tokens = jnp.zeros((16, cfg.max_len), jnp.int32)  # 8 rows per data shard
+
+    flops_pp = traced_matmul_flops(step, opt_state, params, tokens)
+
+    def oracle(params, tokens):
+        return jax.value_and_grad(
+            lambda p: _reference_loss(pp, p, tokens)
+        )(params)
+
+    host_params = jax.tree.map(np.asarray, params)
+    flops_ref = traced_matmul_flops(oracle, host_params, tokens[:8])
+
+    # Expected per-device composition: head+embed exactly 1.0x the oracle
+    # (owning stage only, once per microbatch), blocks (M+P-1)/(M*P) = 0.44x
+    # (one stage's layers, rectangular schedule). Head-dominant config =>
+    # total ~0.8x. The pre-restructure code measured ~1.6x here (head+embed
+    # (M+P-1)/M = 1.75x on every stage).
+    ratio = flops_pp / flops_ref
+    assert ratio < 1.1, (
+        f"pipeline step does {ratio:.2f}x the oracle's matmul FLOPs per "
+        "device — head/embed are being re-applied on non-owning stages"
+    )
+    assert ratio > 0.5, ratio  # sanity floor: blocks can't vanish
+
+
+def test_unknown_schedule_rejected():
+    mesh = build_mesh(MeshSpec(data=1, pipe=4, model=2))
+    with pytest.raises(ValueError):
+        PipelinedLM(mesh, CFG, num_microbatches=2, schedule="pipedream-2bw")
 
 
 def test_layers_must_divide_stages():
