@@ -23,6 +23,9 @@ def main() -> None:
                     help=">1 needs that many devices (e.g. --fake-devices 8 "
                          "--model-parallel 4)")
     ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--attn", choices=["auto", "dense", "flash"],
+                    default="auto",
+                    help="flash composes with TP via custom_partitioning")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -48,7 +51,7 @@ def main() -> None:
 
     cfg = dataclasses.replace(
         bert_base(num_classes=2, dtype=jnp.bfloat16),
-        num_layers=args.layers, max_len=args.seq_len)
+        num_layers=args.layers, max_len=args.seq_len, attn_impl=args.attn)
     model = Transformer(cfg)
     tp = TensorParallel(mesh)
 

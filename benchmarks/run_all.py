@@ -19,6 +19,7 @@ BENCHES = [
     "bench_bert_tp.py",       # config 3
     "bench_wide_deep.py",     # config 4
     "bench_gpt2_pp.py",       # config 5
+    "bench_native_input.py",  # config 1 fed from the C++ record loader
 ]
 
 # Tiny fake-device configs, small enough for CPU (also used by
@@ -35,6 +36,9 @@ SMOKE = {
     "bench_gpt2_pp.py":
         ["--fake-devices", "8", "--pipe", "2", "--small", "--microbatches",
          "2", "--microbatch-size", "1", "--seq-len", "64", "--steps", "2"],
+    "bench_native_input.py":
+        ["--fake-devices", "8", "--global-batch", "64", "--records", "512",
+         "--steps", "5"],
 }
 
 

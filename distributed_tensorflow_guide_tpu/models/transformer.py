@@ -45,14 +45,15 @@ class TransformerConfig:
     # "dense"  — XLA softmax attention (materializes (S, S) scores). GSPMD
     #            partitions it under pjit, so it composes with TP sharding.
     # "flash"  — fused Pallas kernel (ops/flash_attention.py); falls back to
-    #            the pure-XLA blockwise path on unsupported shapes. Use inside
-    #            shard_map strategies (DP/PP/SP — per-device local arrays);
-    #            under pjit/TP GSPMD cannot partition the custom call.
+    #            the pure-XLA blockwise path on unsupported shapes. Works
+    #            inside shard_map strategies (DP/PP/SP — per-device local
+    #            arrays) AND under pjit/TP: the kernel carries a
+    #            custom_partitioning rule that shards batch/heads (heads →
+    #            the "model" axis) and replicates seq/head_dim.
     # "auto"   — flash for causal long-context (max_len >= 1024), else dense.
     #            Measured on the v5 lite chip: dense wins below ~1k tokens
     #            (XLA's fused softmax beats the kernel-dispatch overhead) and
     #            CANNOT COMPILE at >= 1024 under remat, where flash runs.
-    #            TP/pjit users should pin "dense" explicitly.
     attn_impl: str = "auto"
 
     def __post_init__(self):

@@ -25,6 +25,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
@@ -72,24 +73,84 @@ class DataParallel:
         return jax.device_put(state, sharding)
 
     # ---- compiled steps ----------------------------------------------------
-    def _compile_step(self, sm_step, donate: bool):
+    def _compile_step(self, sm_step, donate: bool, steps_per_call: int = 1,
+                      stacked_batch: bool = False):
         """shard_map + jit a per-device ``(state, batch) -> (state, metrics)``
         body: state replicated, batch sharded on its leading axis,
-        explicit collectives (hence check_vma=False)."""
-        sharded = jax.shard_map(
-            sm_step,
+        explicit collectives (hence check_vma=False).
+
+        ``steps_per_call > 1`` runs that many optimizer steps inside ONE
+        compiled program (a ``lax.scan`` around the sharded step) — the TF
+        ``steps_per_run`` / Keras ``steps_per_execution`` knob. On a
+        remote-attached chip each executable dispatch costs milliseconds of
+        host/tunnel latency; measured on the axon v5e, the ResNet-50 device
+        step is 46.9 ms but wall-clock was 62 ms — ~15 ms/step of dispatch
+        overhead that this knob amortizes away. With ``stacked_batch`` the
+        batch carries a leading ``steps_per_call`` axis (one microbatch per
+        inner step — the real-training mode); otherwise the same batch is
+        re-used every inner step (synthetic benchmarking mode). Metrics
+        returned are the LAST inner step's.
+        """
+        if steps_per_call == 1:
+            if stacked_batch:
+                raise ValueError(
+                    "stacked_batch requires steps_per_call > 1 (a stacked "
+                    "batch's leading axis is consumed one slice per inner "
+                    "step)"
+                )
+            sharded = jax.shard_map(
+                sm_step,
+                mesh=self.mesh,
+                in_specs=(P(), P(self.axis)),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+            return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+        if stacked_batch:
+            def multi(state, batch):
+                lead = {jax.tree.leaves(batch)[0].shape[0]}
+                if lead != {steps_per_call}:
+                    raise ValueError(
+                        f"stacked batch leading axis {lead} != "
+                        f"steps_per_call={steps_per_call}; the scan would "
+                        "silently run a different number of optimizer steps"
+                    )
+
+                def body(st, b):
+                    st, m = sm_step(st, b)
+                    return st, m
+
+                state, ms = lax.scan(body, state, batch)
+                return state, jax.tree.map(lambda x: x[-1], ms)
+        else:
+            def multi(state, batch):
+                def body(st, _):
+                    st, m = sm_step(st, batch)
+                    return st, m
+
+                state, ms = lax.scan(
+                    body, state, None, length=steps_per_call
+                )
+                return state, jax.tree.map(lambda x: x[-1], ms)
+
+        batch_spec = (P(None, self.axis) if stacked_batch
+                      else P(self.axis))
+        multi_sharded = jax.shard_map(
+            multi,
             mesh=self.mesh,
-            in_specs=(P(), P(self.axis)),
+            in_specs=(P(), batch_spec),
             out_specs=(P(), P()),
             check_vma=False,
         )
-        return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+        return jax.jit(multi_sharded, donate_argnums=(0,) if donate else ())
 
     def _pmean_metrics(self, mets: dict) -> dict:
         return {k: cc.pmean(v, self.axis) for k, v in mets.items()}
 
     def make_train_step(self, loss_fn: LossFn, *, donate: bool = True,
-                        accum_steps: int = 1):
+                        accum_steps: int = 1, steps_per_call: int = 1,
+                        stacked_batch: bool = False):
         """Compile ``(state, batch) -> (state, metrics)``.
 
         ``state`` is a flax TrainState (replicated); ``batch`` a pytree
@@ -135,9 +196,12 @@ class DataParallel:
             state = state.apply_gradients(grads=grads)
             return state, self._pmean_metrics({"loss": loss, **mets})
 
-        return self._compile_step(sm_step, donate)
+        return self._compile_step(sm_step, donate, steps_per_call,
+                                  stacked_batch)
 
-    def make_train_step_with_stats(self, loss_fn, *, donate: bool = True):
+    def make_train_step_with_stats(self, loss_fn, *, donate: bool = True,
+                                   steps_per_call: int = 1,
+                                   stacked_batch: bool = False):
         """Like :meth:`make_train_step` for models with non-trainable state
         (BatchNorm running stats).
 
@@ -159,7 +223,8 @@ class DataParallel:
             state = state.apply_gradients(grads=grads, model_state=new_ms)
             return state, self._pmean_metrics({"loss": loss, **mets})
 
-        return self._compile_step(sm_step, donate)
+        return self._compile_step(sm_step, donate, steps_per_call,
+                                  stacked_batch)
 
     def make_eval_step(self, metric_fn: Callable[[Any, Any], dict]):
         """Compile ``(state, batch) -> metrics`` with pmean-ed metrics."""

@@ -53,20 +53,13 @@ class TensorParallel:
     # -- layout ---------------------------------------------------------------
     def init_params(self, model: nn.Module, rng, *sample_args):
         """Initialize with every param materialized directly into its shard
-        layout (no host-side full copy — how 100B-param states fit)."""
-        # TP runs under pjit/GSPMD, which cannot partition the Pallas flash
-        # custom call; catch a flash-resolving config here with an actionable
-        # error instead of a cryptic partitioner failure at compile time.
-        cfg = getattr(model, "cfg", None)
-        if getattr(cfg, "resolved_attn_impl", None) == "flash":
-            raise ValueError(
-                "TensorParallel requires attn_impl='dense' (GSPMD cannot "
-                "partition the Pallas flash custom call under pjit); this "
-                f"config resolves to 'flash' (attn_impl={cfg.attn_impl!r}, "
-                f"causal={cfg.causal}, max_len={cfg.max_len}). Pin "
-                "attn_impl='dense', or use a shard_map strategy (DP/PP/SP) "
-                "for flash."
-            )
+        layout (no host-side full copy — how 100B-param states fit).
+
+        attn_impl='flash' composes: the Pallas kernel carries a
+        ``custom_partitioning`` rule (ops/flash_attention.py) that shards
+        batch/heads and replicates seq/head_dim, so GSPMD partitions it like
+        any other op (heads map to the ``model`` axis under DEFAULT_RULES).
+        """
 
         def init_fn():
             return model.init(rng, *sample_args)
@@ -104,9 +97,22 @@ class TensorParallel:
             state = state.apply_gradients(grads=grads)
             return state, {"loss": loss, **mets}
 
-        return jax.jit(
+        jitted = jax.jit(
             step,
             in_shardings=(state_shardings, batch_sharding),
             out_shardings=(state_shardings, NamedSharding(self.mesh, P())),
             donate_argnums=(0,) if donate else (),
         )
+
+        # Trace-time mesh context: ops that dispatch on the ambient mesh
+        # (the flash kernel's custom_partitioning path) must see this pjit
+        # program's mesh, which jit alone does not establish. The LEGACY
+        # `with mesh:` context — NOT jax.set_mesh — because set_mesh turns
+        # flax's global_mesh_defined() on and eagerly applies every logical
+        # constraint, breaking DenseGeneral+with_logical_partitioning (flat
+        # rank-2 kernel init vs rank-4 logical names).
+        def step_in_mesh(state, batch):
+            with self.mesh:
+                return jitted(state, batch)
+
+        return step_in_mesh

@@ -221,3 +221,68 @@ def test_profiler_hook_stops_on_early_end(tmp_path):
     assert hook._active
     hook.end(1)
     assert not hook._active
+
+
+# ---- the determinism gate (reference R2 control discipline) -----------------
+# One command reproduces the reference's control-vs-distributed diff: the
+# examples/non_distributed.py trainer is the oracle, and the same training
+# run under several mesh topologies must match it (SURVEY.md §4 item 3).
+
+
+def test_mnist_topology_determinism_gate():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    import optax
+    from flax.training import train_state
+
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.data.synthetic import synthetic_mnist
+    from distributed_tensorflow_guide_tpu.models.mnist_cnn import (
+        MNISTCNN,
+        make_loss_fn,
+    )
+    from distributed_tensorflow_guide_tpu.parallel.data_parallel import (
+        DataParallel,
+    )
+    from distributed_tensorflow_guide_tpu.utils.determinism import (
+        check_topologies,
+    )
+    from examples.non_distributed import train as control_train
+
+    STEPS, BATCH, LR, SEED = 5, 32, 0.05, 0
+
+    def dp_train(spec_accum, seed: int):
+        spec, accum = spec_accum
+        mesh = build_mesh(spec, devices=jax.devices()[:spec.data])
+        dp = DataParallel(mesh)
+        model = MNISTCNN()
+        params = model.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, 28, 28, 1))
+        )["params"]
+        state = dp.replicate(train_state.TrainState.create(
+            apply_fn=model.apply, params=params,
+            tx=optax.sgd(LR, momentum=0.9),
+        ))
+        step = dp.make_train_step(make_loss_fn(model), donate=False,
+                                  accum_steps=accum)
+        out = []
+        for b in synthetic_mnist(BATCH, seed=seed).take(STEPS):
+            state, m = step(state, dp.shard_batch(b))
+            out.append({k: float(v) for k, v in m.items()})
+        return out
+
+    # same seed, same global batch; topologies: full-mesh DP, 2-way DP,
+    # and 4-way DP with 2-step gradient accumulation (mean-of-means ==
+    # full-batch mean at equal microbatch sizes)
+    specs = [(MeshSpec(data=8), 1), (MeshSpec(data=2), 1),
+             (MeshSpec(data=4), 2)]
+    rep = check_topologies(dp_train, specs, seed=SEED, rtol=1e-4)
+    rep.raise_if_failed()
+
+    # and all of them must match the single-device control trainer
+    control = control_train(STEPS, BATCH, LR, seed=SEED)
+    dp8 = dp_train(specs[0], SEED)
+    for c, d in zip(control, dp8):
+        assert abs(c["loss"] - d["loss"]) <= 1e-4 * max(abs(c["loss"]), 1e-12)
