@@ -1,0 +1,121 @@
+"""Fully-sharded data parallelism (ZeRO-3 / FSDP) over the ``data`` axis.
+
+Reference context: the guide's synchronous track replicates every variable
+on every worker (⚠ Synchronous-SGD/ via ``SyncReplicasOptimizer``,
+tensorflow/python/training/sync_replicas_optimizer.py:42; modern surface
+``MultiWorkerMirroredStrategy``) — parameter memory grows with model size
+on EVERY device. FSDP is that strategy's at-scale completion: parameters
+and optimizer state are *sharded* over the same ``data`` axis the batch is
+split over, and the compiler materializes each parameter only for the
+instant its layer runs.
+
+The TPU expression is pure sharding annotation — no wrapper classes, no
+hooks, no manual all-gathers (contrast torch FSDP's module wrapping): give
+every large parameter leaf a ``NamedSharding`` that splits its largest
+divisible dimension over ``data``, shard the batch over ``data``, and jit.
+GSPMD then inserts exactly ZeRO-3's communication schedule: all-gather
+params before use, reduce-scatter gradients after the backward — all on
+ICI. Numerically equivalent to plain sync DP (tested to 1e-4 over a
+training trajectory; reduction orders differ, so not bit-exact).
+
+Memory per device: params/world + optimizer state/world + one layer's
+gathered params transiently — how models ~world× larger than HBM fit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_guide_tpu.core.mesh import axis_sizes
+
+LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
+
+
+def shard_spec_for(shape: tuple[int, ...], world: int,
+                   min_size: int = 2 ** 14, axis: str = "data") -> P:
+    """Pick the FSDP spec for one parameter: split the largest dimension
+    divisible by ``world``; tiny or indivisible leaves stay replicated
+    (biases, norms — sharding them buys nothing and costs a gather)."""
+    if int(np.prod(shape or (1,))) < min_size:
+        return P()
+    dims = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in dims:
+        if shape[i] % world == 0 and shape[i] >= world:
+            spec = [None] * len(shape)
+            spec[i] = axis
+            return P(*spec)
+    return P()
+
+
+class FSDP:
+    """Build compiled fully-sharded train steps over the ``data`` axis.
+
+    Same surface as :class:`~..parallel.tensor.TensorParallel`:
+    ``init_params`` materializes each leaf directly into its shard,
+    ``state_shardings`` extends the layout to the optimizer state, and
+    ``make_train_step`` jits with those shardings pinned.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str = "data",
+                 min_shard_size: int = 2 ** 14):
+        self.mesh = mesh
+        self.axis = axis
+        self.world = axis_sizes(mesh)[axis]
+        self.min_shard_size = min_shard_size
+
+    # -- layout ---------------------------------------------------------------
+    def param_shardings(self, params_shape: Any) -> Any:
+        """Shardings for an (abstract) param tree."""
+        def one(leaf):
+            spec = shard_spec_for(leaf.shape, self.world,
+                                  self.min_shard_size, axis=self.axis)
+            return NamedSharding(self.mesh, spec)
+
+        return jax.tree.map(one, params_shape)
+
+    def init_params(self, init_fn: Callable[[], Any]) -> tuple[Any, Any]:
+        """Run ``init_fn`` with outputs materialized directly into their
+        shards (no device ever holds the full parameter tree)."""
+        abstract = jax.eval_shape(init_fn)
+        shardings = self.param_shardings(abstract)
+        params = jax.jit(init_fn, out_shardings=shardings)()
+        return params, shardings
+
+    def state_shardings(self, state: Any, param_shardings: Any) -> Any:
+        """Optimizer moments inherit their param's sharding (matched by
+        shape+dtype); everything else replicates."""
+        from distributed_tensorflow_guide_tpu.utils.spec_utils import (
+            assign_by_shape,
+        )
+
+        return assign_by_shape(
+            state.params, param_shardings, state,
+            NamedSharding(self.mesh, P()),
+        )
+
+    # -- compiled step ---------------------------------------------------------
+    def make_train_step(self, loss_fn: LossFn, state_shardings: Any,
+                        *, donate: bool = True):
+        """``(state, batch) -> (state, metrics)``. The batch is sharded over
+        ``data`` like plain DP; params stay in their FSDP shards across
+        steps — only the transient gathered copies exist during compute."""
+        batch_sharding = NamedSharding(self.mesh, P(self.axis))
+
+        def step(state, batch):
+            (loss, mets), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params, batch)
+            state = state.apply_gradients(grads=grads)
+            return state, {"loss": loss, **mets}
+
+        return jax.jit(
+            step,
+            in_shardings=(state_shardings, batch_sharding),
+            out_shardings=(state_shardings, NamedSharding(self.mesh, P())),
+            donate_argnums=(0,) if donate else (),
+        )

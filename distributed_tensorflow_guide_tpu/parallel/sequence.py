@@ -235,16 +235,26 @@ def _ring_flash_public(q, k, v, *, axis: str, causal: bool):
 
 
 def ulysses_attention(q, k, v, *, axis: str = "context",
-                      causal: bool = False):
+                      causal: bool = False, impl: str = "auto"):
     """Ulysses: all_to_all seq→heads, full-sequence attention on a head
     shard, all_to_all heads→seq back.
 
     Per-device in/out: (B, S_local, H, D); requires H % axis_size == 0.
+
+    ``impl``: the attention core after resharding sees the FULL sequence,
+    so long contexts need the fused kernel — "auto" uses the Pallas flash
+    kernel (ops/flash_attention.py) when the global seq length fits its
+    blocks, dense otherwise; "dense"/"flash" pin the choice.
     """
+    if impl not in ("auto", "dense", "flash"):
+        raise ValueError(f"unknown ulysses impl {impl!r}")
     n = lax.axis_size(axis)
     h = q.shape[2]
     if h % n:
-        raise ValueError(f"num_heads {h} must divide context size {n}")
+        raise ValueError(
+            f"context size {n} must divide num_heads {h} (each device "
+            "takes H/n heads after the all_to_all)"
+        )
 
     def to_heads(x):  # (B, S/n, H, D) -> (B, S, H/n, D)
         return cc.all_to_all(x, axis, split_axis=2, concat_axis=1)
@@ -252,7 +262,19 @@ def ulysses_attention(q, k, v, *, axis: str = "context",
     def to_seq(x):  # (B, S, H/n, D) -> (B, S/n, H, D)
         return cc.all_to_all(x, axis, split_axis=1, concat_axis=2)
 
-    out = A.dense_attention(
-        to_heads(q), to_heads(k), to_heads(v), causal=causal
-    )
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    s_global, d = qh.shape[1], qh.shape[-1]
+    fits = F.supported(s_global, d)
+    if impl == "flash" and not fits:
+        # pinning the kernel must not silently take the slow path (the same
+        # contract as ring impl="pallas")
+        raise ValueError(
+            f"impl='flash' needs global seq length divisible by 128 (got "
+            f"{s_global}); use impl='dense' or pad the sequence"
+        )
+    use_flash = impl == "flash" or (impl == "auto" and fits)
+    if use_flash:
+        out = F.flash_attention(qh, kh, vh, causal=causal)
+    else:
+        out = A.dense_attention(qh, kh, vh, causal=causal)
     return to_seq(out)

@@ -236,3 +236,31 @@ def test_ring_flash_matches_ring_xla():
 
     np.testing.assert_allclose(run("pallas"), run("xla"), rtol=2e-2,
                                atol=2e-2)
+
+
+def test_ulysses_flash_core_equals_dense():
+    """Ulysses with the flash core (global seq 256 fits the kernel blocks)
+    must match dense — forward and gradients."""
+    mesh = _ctx_mesh(4)  # H=4 heads over 4-way context
+    rng = np.random.RandomState(4)
+    mk = lambda: jnp.asarray(rng.randn(2, 256, 4, 16), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+
+    def run(impl):
+        sm = jax.shard_map(
+            functools.partial(ulysses_attention, causal=True, impl=impl),
+            mesh=mesh,
+            in_specs=(P(None, "context"),) * 3,
+            out_specs=P(None, "context"),
+            check_vma=False,
+        )
+        out = jax.jit(sm)(q, k, v)
+        g = jax.jit(jax.grad(lambda q: jnp.sum(sm(q, k, v) ** 2)))(q)
+        return np.asarray(out), np.asarray(g)
+
+    out_f, g_f = run("flash")
+    out_d, g_d = run("dense")
+    np.testing.assert_allclose(out_f, out_d, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(g_f, g_d, rtol=1e-4, atol=1e-4)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out_f, np.asarray(ref), rtol=1e-4, atol=1e-4)

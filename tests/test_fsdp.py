@@ -1,0 +1,144 @@
+"""FSDP (ZeRO-3 over the data axis): sharding layout + DP-parity.
+
+The contract: fully-sharded training is an EXECUTION layout, not a
+different algorithm — same numerics as replicated sync DP, params/moments
+actually sharded over ``data``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax.training import train_state
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+from distributed_tensorflow_guide_tpu.models.mnist_cnn import (
+    MNISTCNN,
+    make_loss_fn,
+)
+from distributed_tensorflow_guide_tpu.parallel.data_parallel import DataParallel
+from distributed_tensorflow_guide_tpu.parallel.fsdp import (
+    FSDP,
+    shard_spec_for,
+)
+
+
+def test_shard_spec_policy():
+    # big divisible dim -> sharded on its largest divisible axis
+    assert tuple(shard_spec_for((256, 512), 8)) == (None, "data")
+    assert tuple(shard_spec_for((1024, 384), 8)) == ("data", None)
+    # tiny leaves (biases/norms) replicate
+    assert tuple(shard_spec_for((128,), 8)) == ()
+    # indivisible dims replicate rather than pad
+    assert tuple(shard_spec_for((270, 130), 8, min_size=1)) == ()
+
+
+def _setup(lr=0.1):
+    mesh = build_mesh(MeshSpec(data=-1))
+    model = MNISTCNN()
+    fsdp = FSDP(mesh, min_shard_size=2 ** 10)
+
+    def init_fn():
+        p = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+        return p["params"]
+
+    params, shardings = fsdp.init_params(init_fn)
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(lr, momentum=0.9)
+    )
+    st_sh = fsdp.state_shardings(state, shardings)
+    state = jax.device_put(state, st_sh)
+    return mesh, model, fsdp, state, st_sh
+
+
+def test_params_and_moments_actually_sharded():
+    mesh, model, fsdp, state, st_sh = _setup()
+    # the dense kernel (3136, 128) or conv kernels must be split over data
+    sharded_leaves = [
+        l for l in jax.tree.leaves(state.params)
+        if "data" in tuple(l.sharding.spec)
+    ]
+    assert sharded_leaves, "no parameter leaf is sharded over data"
+    big = max(jax.tree.leaves(state.params), key=lambda l: l.size)
+    assert "data" in tuple(s for s in big.sharding.spec if s)
+    assert big.addressable_shards[0].data.size == big.size // 8
+    # momentum follows
+    mu_big = max(jax.tree.leaves(state.opt_state[0].trace),
+                 key=lambda l: l.size)
+    assert "data" in tuple(s for s in mu_big.sharding.spec if s)
+
+
+def test_fsdp_matches_replicated_dp():
+    from distributed_tensorflow_guide_tpu.data.synthetic import synthetic_mnist
+
+    mesh, model, fsdp, state_f, st_sh = _setup()
+    loss_fn = make_loss_fn(model)
+    step_f = fsdp.make_train_step(loss_fn, st_sh, donate=False)
+
+    dp = DataParallel(mesh)
+    params0 = jax.tree.map(np.asarray, state_f.params)
+    state_d = dp.replicate(train_state.TrainState.create(
+        apply_fn=model.apply, params=params0,
+        tx=optax.sgd(0.1, momentum=0.9),
+    ))
+    step_d = dp.make_train_step(loss_fn, donate=False)
+
+    for b in synthetic_mnist(32, seed=7).take(5):
+        state_f, m_f = step_f(state_f, jax.device_put(
+            b, jax.NamedSharding(mesh, P("data"))))
+        state_d, m_d = step_d(state_d, dp.shard_batch(b))
+        np.testing.assert_allclose(float(m_f["loss"]), float(m_d["loss"]),
+                                   rtol=1e-5)
+
+    for a, b_ in zip(jax.tree.leaves(state_f.params),
+                     jax.tree.leaves(state_d.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_fsdp_transformer_trains():
+    """FSDP on the transformer (the model family whose size motivates it)."""
+    import dataclasses
+
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        make_lm_loss_fn,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=256, num_layers=2, num_heads=4, d_model=64, d_ff=128,
+        max_len=32, causal=True, dtype=jnp.float32,
+    )
+    mesh = build_mesh(MeshSpec(data=-1))
+    model = Transformer(cfg)
+    fsdp = FSDP(mesh, min_shard_size=2 ** 10)
+    tokens0 = jnp.zeros((1, cfg.max_len), jnp.int32)
+
+    def init_fn():
+        import flax.linen as nn
+
+        return nn.meta.unbox(
+            model.init(jax.random.PRNGKey(0), tokens0)
+        )["params"]
+
+    params, shardings = fsdp.init_params(init_fn)
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.adam(1e-3)
+    )
+    st_sh = fsdp.state_shardings(state, shardings)
+    state = jax.device_put(state, st_sh)
+    step = fsdp.make_train_step(make_lm_loss_fn(model), st_sh, donate=False)
+
+    rng = np.random.RandomState(0)
+    batch = {"tokens": rng.randint(0, 256, (16, cfg.max_len)).astype(np.int32)}
+    losses = []
+    for _ in range(15):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.85, losses
+    # embedding and mlp kernels sharded
+    emb = state.params["tok_emb"]["embedding"]
+    assert "data" in tuple(s for s in emb.sharding.spec if s)
