@@ -20,6 +20,7 @@ BENCHES = [
     "bench_wide_deep.py",     # config 4
     "bench_gpt2_pp.py",       # config 5
     "bench_native_input.py",  # config 1 fed from the C++ record loader
+    "bench_ring_attention.py",  # long-context SP: Pallas kernel vs XLA path
 ]
 
 # Tiny fake-device configs, small enough for CPU (also used by
@@ -39,6 +40,9 @@ SMOKE = {
     "bench_native_input.py":
         ["--fake-devices", "8", "--global-batch", "64", "--records", "512",
          "--steps", "5"],
+    "bench_ring_attention.py":
+        ["--fake-devices", "8", "--context", "4", "--seq-len", "512",
+         "--batch", "1", "--heads", "2", "--head-dim", "16", "--iters", "2"],
 }
 
 

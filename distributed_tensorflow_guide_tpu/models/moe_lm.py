@@ -1,0 +1,247 @@
+"""Switch-Transformer LM — the MoE model family, wired end to end.
+
+``parallel/expert.py`` provides the EP machinery (static-shape top-k
+dispatch, dual ``all_to_all`` token exchange, Switch aux losses) as a
+standalone layer; this module is the model that USES it: a causal LM whose
+every block replaces the dense FFN with the routed MoE FFN (Switch
+Transformer, Fedus et al. 2021), trained over a ``data × expert`` mesh.
+
+No reference equivalent (the guide predates MoE; SURVEY.md §2c lists EP as
+a stretch goal). Structure mirrors :class:`~..parallel.pipeline.PipelinedLM`:
+a strategy-owning class whose flax submodules (embedder, attention blocks,
+head) carry replicated params while the expert stacks are raw arrays
+sharded over the ``expert`` axis — tokens travel, parameters stay.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.core.mesh import axis_sizes
+from distributed_tensorflow_guide_tpu.models.transformer import (
+    MultiHeadAttention,
+    TransformerConfig,
+)
+from distributed_tensorflow_guide_tpu.parallel.expert import (
+    MoEConfig,
+    init_moe_params,
+    moe_ffn,
+)
+from distributed_tensorflow_guide_tpu.utils.spec_utils import (
+    assign_by_shape,
+    expand_prefix,
+)
+
+
+class _AttnBlock(nn.Module):
+    """Pre-LN attention half of a block: x + attn(LN(x)). The FFN half is
+    the routed MoE layer, applied outside flax."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        return x + MultiHeadAttention(self.cfg, name="attn")(
+            nn.LayerNorm(dtype=self.cfg.dtype, name="ln1")(x)
+        )
+
+
+class _Embedder(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                     name="tok_emb")(tokens)
+        pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=cfg.dtype,
+                       name="pos_emb")(jnp.arange(tokens.shape[1])[None, :])
+        return x + pos
+
+
+class _Head(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=self.cfg.dtype, name="ln_f")(x)
+        return nn.Dense(self.cfg.vocab_size, dtype=jnp.float32,
+                        use_bias=False, name="lm_head")(x)
+
+
+class SwitchLM:
+    """Causal Switch-MoE LM over the ``data × expert`` mesh axes.
+
+    Batch rows are sharded jointly over both axes (every device in the
+    grid holds a distinct slice); expert stacks are sharded over
+    ``expert``; everything else is replicated. Aux losses (load balance +
+    router z) are added to the LM loss with ``aux_weight``.
+    """
+
+    def __init__(self, mesh: Mesh, cfg: TransformerConfig,
+                 num_experts: int, *, top_k: int = 1,
+                 capacity_factor: float = 2.0, aux_weight: float = 1e-2):
+        sizes = axis_sizes(mesh)
+        if num_experts % sizes["expert"]:
+            raise ValueError(
+                f"num_experts {num_experts} not divisible by expert axis "
+                f"size {sizes['expert']}"
+            )
+        self.mesh = mesh
+        self.cfg = cfg
+        self.n_data = sizes["data"]
+        self.n_expert = sizes["expert"]
+        self.aux_weight = aux_weight
+        self.moe_cfg = MoEConfig(
+            d_model=cfg.d_model, d_ff=cfg.d_ff, num_experts=num_experts,
+            top_k=top_k, capacity_factor=capacity_factor,
+            dtype=cfg.dtype,
+        )
+        self.embedder = _Embedder(cfg)
+        self.attn_block = _AttnBlock(cfg)
+        self.ln2 = nn.LayerNorm(dtype=cfg.dtype)
+        self.head = _Head(cfg)
+
+    # -- params ---------------------------------------------------------------
+    def init_params(self, rng) -> dict:
+        cfg = self.cfg
+        r_emb, r_attn, r_ln, r_moe, r_head = jax.random.split(rng, 5)
+        dummy_tok = jnp.zeros((1, cfg.max_len), jnp.int32)
+        dummy_x = jnp.zeros((1, cfg.max_len, cfg.d_model), cfg.dtype)
+
+        attn = jax.vmap(
+            lambda k: self.attn_block.init(k, dummy_x)["params"]
+        )(jax.random.split(r_attn, cfg.num_layers))
+        ln2 = jax.vmap(
+            lambda k: self.ln2.init(k, dummy_x)["params"]
+        )(jax.random.split(r_ln, cfg.num_layers))
+        moe = jax.vmap(
+            lambda k: init_moe_params(self.moe_cfg, k)
+        )(jax.random.split(r_moe, cfg.num_layers))
+        params = {
+            "embed": self.embedder.init(r_emb, dummy_tok)["params"],
+            "attn": attn,
+            "ln2": ln2,
+            "moe": moe,
+            "head": self.head.init(r_head, dummy_x)["params"],
+        }
+        return jax.device_put(params, self.param_shardings())
+
+    def param_specs(self) -> dict:
+        return {
+            "embed": P(), "attn": P(), "ln2": P(),
+            "moe": {
+                "router": P(),
+                # (L, E, d, ff): expert dim sharded over the expert axis
+                "w_in": P(None, "expert"),
+                "w_out": P(None, "expert"),
+            },
+            "head": P(),
+        }
+
+    def param_shardings(self):
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), self.param_specs(),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    # -- forward --------------------------------------------------------------
+    def _forward(self, params, tokens):
+        """Per-device forward: tokens (B_local, S) -> (logits, aux)."""
+        cfg = self.cfg
+        x = self.embedder.apply({"params": params["embed"]}, tokens)
+        b, s, d = x.shape
+
+        def layer(h, lp):
+            h = self.attn_block.apply({"params": lp["attn"]}, h)
+            pre = self.ln2.apply({"params": lp["ln2"]}, h)
+            y, aux = moe_ffn(lp["moe"], pre.reshape(b * s, d), self.moe_cfg)
+            return h + y.reshape(b, s, d), aux
+
+        x, auxs = lax.scan(
+            layer, x, {"attn": params["attn"], "ln2": params["ln2"],
+                       "moe": params["moe"]}
+        )
+        logits = self.head.apply({"params": params["head"]}, x)
+        aux = jax.tree.map(jnp.mean, auxs)  # mean over layers
+        return logits, aux
+
+    def _local_loss(self, params, tokens):
+        """Global-mean LM loss + aux, computed from this device's shard."""
+        logits, aux = self._forward(params, tokens)
+        logp = jax.nn.log_softmax(logits[:, :-1])
+        ll = jnp.take_along_axis(
+            logp, tokens[:, 1:][..., None], axis=-1
+        )[..., 0]
+        se = -jnp.sum(ll)
+        n = jnp.array(ll.size, jnp.float32)
+        axes = self.moe_cfg.token_axes
+        lm = cc.psum(se, axes) / cc.psum(n, axes)
+        loss = lm + self.aux_weight * (aux["load_balance"] + aux["z_loss"])
+        return loss, {"lm_loss": lm, **aux}
+
+    # -- compiled step --------------------------------------------------------
+    def opt_state_specs(self, tx: optax.GradientTransformation, params):
+        """Optimizer moments inherit their param's spec (matched by
+        shape+dtype); scalars/counts replicate."""
+        return assign_by_shape(
+            params, expand_prefix(self.param_specs(), params),
+            jax.eval_shape(tx.init, params), P(),
+        )
+
+    def make_train_step(self, tx: optax.GradientTransformation, params,
+                        *, donate: bool = True):
+        """``(opt_state, params, tokens (B, S)) -> (opt_state, params,
+        metrics)``; B divisible by n_data * n_expert."""
+        specs = self.param_specs()
+        opt_specs = self.opt_state_specs(tx, params)
+        axes = self.moe_cfg.token_axes
+
+        def sm_step(opt_state, params, tokens):
+            (loss, mets), grads = jax.value_and_grad(
+                self._local_loss, has_aux=True
+            )(params, tokens)
+            # loss is the GLOBAL mean -> per-device grads are partial
+            # contributions. Replicated leaves (embed/attn/ln/head/router):
+            # psum over both token axes. Expert-sharded stacks: the expert
+            # axis contributions already arrived through the backward
+            # all_to_all, so psum over data only.
+            grads = {
+                "embed": cc.psum(grads["embed"], axes),
+                "attn": cc.psum(grads["attn"], axes),
+                "ln2": cc.psum(grads["ln2"], axes),
+                "moe": {
+                    "router": cc.psum(grads["moe"]["router"], axes),
+                    "w_in": cc.psum(grads["moe"]["w_in"], "data"),
+                    "w_out": cc.psum(grads["moe"]["w_out"], "data"),
+                },
+                "head": cc.psum(grads["head"], axes),
+            }
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return opt_state, params, {"loss": loss, **mets}
+
+        sharded = jax.shard_map(
+            sm_step,
+            mesh=self.mesh,
+            in_specs=(opt_specs, specs, P(self.moe_cfg.token_axes)),
+            out_specs=(opt_specs, specs, P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+
+    def init_opt_state(self, tx, params):
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            self.opt_state_specs(tx, params),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        with self.mesh:
+            return jax.jit(tx.init, out_shardings=shardings)(params)

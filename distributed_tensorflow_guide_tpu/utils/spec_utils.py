@@ -31,8 +31,15 @@ def assign_by_shape(ref_tree: Any, ref_assignments: Any, target_tree: Any,
 
 
 def expand_prefix(prefix_assignments: dict, tree: dict) -> dict:
-    """Expand a {subtree_name: assignment} prefix into a full per-leaf tree."""
-    return {
-        name: jax.tree.map(lambda _: prefix_assignments[name], subtree)
-        for name, subtree in tree.items()
-    }
+    """Expand a prefix-assignment tree into a full per-leaf tree.
+
+    Each position in ``prefix_assignments`` is either a dict (recursed —
+    the assignment goes deeper than one level, e.g. SwitchLM's
+    ``{"moe": {"router": P(), "w_in": P(None, "expert"), ...}}``) or a
+    single assignment broadcast over the whole corresponding subtree."""
+    def expand(assign: Any, sub: Any) -> Any:
+        if isinstance(assign, dict):
+            return {k: expand(assign[k], sub[k]) for k in sub}
+        return jax.tree.map(lambda _: assign, sub)
+
+    return expand(prefix_assignments, tree)

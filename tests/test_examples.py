@@ -23,3 +23,15 @@ def test_long_context_sp_example():
     # ...") from the "ulysses skipped:" path
     assert "ring attention: " in out and "ulysses: " in out
     assert "long-context SP ok" in out
+
+
+def test_non_distributed_control_example():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "non_distributed.py"),
+         "--steps", "5", "--global-batch", "32", "--log-every", "0"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "done: 5 steps" in r.stdout

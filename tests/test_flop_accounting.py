@@ -1,0 +1,76 @@
+"""utils/flop_accounting: scan-trip-aware traced matmul/conv FLOP counts.
+
+The whole reason this module exists is that XLA's cost_analysis counts a
+loop body ONCE; these tests pin the semantics the pipeline FLOP-discipline
+test relies on (scan multiplies, cond takes the max branch, grad adds the
+backward matmuls).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_guide_tpu.utils.flop_accounting import (
+    traced_matmul_flops,
+)
+
+A = jnp.ones((8, 16))
+B_ = jnp.ones((16, 32))
+
+
+def test_single_matmul():
+    got = traced_matmul_flops(lambda a, b: a @ b, A, B_)
+    assert got == 2 * 8 * 16 * 32
+
+
+def test_scan_multiplies_by_trip_count():
+    def f(a, b):
+        def body(c, _):
+            return c, a @ b
+
+        _, ys = jax.lax.scan(body, 0.0, None, length=5)
+        return ys
+
+    assert traced_matmul_flops(f, A, B_) == 5 * 2 * 8 * 16 * 32
+
+
+def test_cond_takes_max_branch():
+    def f(a, b, p):
+        # both branches produce (8, 32); the expensive one does 3 matmuls
+        return jax.lax.cond(
+            p, lambda: ((a @ b) @ B_.T) @ b, lambda: a @ b
+        )
+
+    ab = 2 * 8 * 16 * 32          # (8,16)@(16,32)
+    abT = 2 * 8 * 32 * 16         # (8,32)@(32,16)
+    assert traced_matmul_flops(f, A, B_, True) == ab + abT + ab
+
+
+def test_grad_adds_backward_matmuls():
+    fwd = traced_matmul_flops(lambda a, b: jnp.sum(a @ b), A, B_)
+    both = traced_matmul_flops(
+        jax.grad(lambda a, b: jnp.sum(a @ b), argnums=(0, 1)), A, B_
+    )
+    # dA = g @ B^T and dB = A^T @ g: two more matmuls of the same size
+    assert both == 3 * fwd
+
+
+def test_conv_flops():
+    x = jnp.ones((2, 8, 8, 4))   # NHWC
+    w = jnp.ones((3, 3, 4, 16))  # HWIO
+
+    def f(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    # 2 * batch * out_spatial * Cout * Cin * k
+    assert traced_matmul_flops(f, x, w) == 2 * 2 * 64 * 16 * 4 * 9
+
+
+def test_kwargs_reach_fn():
+    def f(a, b, *, transpose=False):
+        return a @ (b if not transpose else b)
+
+    assert traced_matmul_flops(f, A, B_, transpose=True) == 2 * 8 * 16 * 32

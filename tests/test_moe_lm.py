@@ -1,0 +1,118 @@
+"""Switch-MoE LM (models/moe_lm.py): the EP machinery wired into a real
+causal LM over the data x expert mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+from distributed_tensorflow_guide_tpu.models.moe_lm import SwitchLM
+from distributed_tensorflow_guide_tpu.models.transformer import (
+    TransformerConfig,
+)
+
+CFG = TransformerConfig(
+    vocab_size=64, num_layers=2, num_heads=2, d_model=32, d_ff=64,
+    max_len=16, causal=True, dtype=jnp.float32,
+)
+
+
+def _tokens(batch, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG.vocab_size, (batch, CFG.max_len)).astype(
+        np.int32)
+
+
+def test_single_expert_equals_dense_ffn():
+    """E=1, top_k=1, ample capacity: routing is the identity (softmax over
+    one expert = gate 1.0, no drops), so the MoE LM must equal the same
+    computation with a plain dense FFN — pins the dispatch algebra."""
+    mesh = build_mesh(MeshSpec(data=-1, expert=1))
+    lm = SwitchLM(mesh, CFG, num_experts=1, top_k=1, capacity_factor=2.0,
+                  aux_weight=0.0)
+    params = lm.init_params(jax.random.PRNGKey(0))
+    host = jax.tree.map(np.asarray, params)
+    tokens = _tokens(8)
+
+    tx = optax.sgd(0.1)
+    opt_state = lm.init_opt_state(tx, params)
+    step = lm.make_train_step(tx, params, donate=False)
+    _, _, m = step(opt_state, params, tokens)
+
+    # dense oracle with the SAME weights, no routing (per-layer slicing:
+    # embed/head are not stacked, so walk the tree manually)
+    def oracle(p, toks):
+        x = lm.embedder.apply({"params": p["embed"]}, toks)
+        b, s, d = x.shape
+        for i in range(CFG.num_layers):
+            attn_i = jax.tree.map(lambda a: a[i], p["attn"])
+            ln_i = jax.tree.map(lambda a: a[i], p["ln2"])
+            w_in = p["moe"]["w_in"][i][0]
+            w_out = p["moe"]["w_out"][i][0]
+            x = lm.attn_block.apply({"params": attn_i}, x)
+            pre = lm.ln2.apply({"params": ln_i}, x)
+            h = jax.nn.gelu(pre.reshape(-1, d) @ w_in)
+            x = x + (h @ w_out).reshape(b, s, d)
+        logits = lm.head.apply({"params": p["head"]}, x)
+        logp = jax.nn.log_softmax(logits[:, :-1])
+        ll = jnp.take_along_axis(logp, toks[:, 1:][..., None], -1)[..., 0]
+        return -jnp.mean(ll)
+
+    ref = float(oracle(host, jnp.asarray(tokens)))
+    np.testing.assert_allclose(float(m["lm_loss"]), ref, rtol=1e-5)
+
+
+def test_switch_lm_learns_with_real_routing():
+    mesh = build_mesh(MeshSpec(data=2, expert=4))
+    lm = SwitchLM(mesh, CFG, num_experts=8, top_k=2, capacity_factor=2.0)
+    params = lm.init_params(jax.random.PRNGKey(1))
+    tx = optax.adam(3e-3)
+    opt_state = lm.init_opt_state(tx, params)
+    step = lm.make_train_step(tx, params, donate=False)
+    tokens = _tokens(16, seed=1)  # fixed batch -> memorize
+    losses = []
+    for _ in range(15):
+        opt_state, params, m = step(opt_state, params, tokens)
+        losses.append(float(m["lm_loss"]))
+    assert losses[-1] < losses[0] * 0.8, losses
+    assert np.isfinite(float(m["load_balance"]))
+
+
+def test_expert_stacks_actually_sharded():
+    mesh = build_mesh(MeshSpec(data=2, expert=4))
+    lm = SwitchLM(mesh, CFG, num_experts=8)
+    params = lm.init_params(jax.random.PRNGKey(0))
+    w_in = params["moe"]["w_in"]
+    assert w_in.shape == (CFG.num_layers, 8, CFG.d_model, CFG.d_ff)
+    # each device holds 8/4 = 2 experts
+    assert w_in.addressable_shards[0].data.shape[1] == 2
+    # router replicated
+    r = params["moe"]["router"]
+    assert r.addressable_shards[0].data.shape == r.shape
+
+
+def test_num_experts_must_divide_axis():
+    mesh = build_mesh(MeshSpec(data=2, expert=4))
+    with pytest.raises(ValueError, match="divisible by expert axis"):
+        SwitchLM(mesh, CFG, num_experts=6)
+
+
+def test_opt_state_moments_inherit_expert_sharding():
+    """Regression for a latent spec-derivation bug: the nested moe spec
+    dict must expand per-key (expand_prefix recursion), so Adam moments of
+    the expert stacks land sharded over 'expert' and everything else
+    replicates."""
+    mesh = build_mesh(MeshSpec(data=2, expert=4))
+    lm = SwitchLM(mesh, CFG, num_experts=8)
+    params = lm.init_params(jax.random.PRNGKey(0))
+    tx = optax.adam(1e-3)
+    opt_state = lm.init_opt_state(tx, params)
+    mu = opt_state[0].mu
+    assert tuple(mu["moe"]["w_in"].sharding.spec) == (None, "expert")
+    assert tuple(mu["moe"]["router"].sharding.spec) in ((), (None,) * 0)
+    assert mu["moe"]["w_in"].addressable_shards[0].data.shape[1] == 2
+    # replicated groups stay replicated
+    emb_leaf = jax.tree.leaves(mu["embed"])[0]
+    assert "expert" not in tuple(s for s in emb_leaf.sharding.spec if s)
