@@ -16,13 +16,13 @@ from benchmarks.common import device_setup, report, time_steps
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--global-batch", type=int, default=64)
+    ap.add_argument("--global-batch", type=int, default=256)
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--model-parallel", type=int, default=1,
                     help=">1 needs that many devices (e.g. --fake-devices 8 "
                          "--model-parallel 4)")
-    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--attn", choices=["auto", "dense", "flash"],
                     default="auto",
                     help="flash composes with TP via custom_partitioning")

@@ -24,7 +24,7 @@ def main() -> None:
     # saturates at 32 global sequences; 8x4 is worse than 4x8)
     ap.add_argument("--microbatch-size", type=int, default=8)
     ap.add_argument("--seq-len", type=int, default=512)
-    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--small", action="store_true",
                     help="4-layer toy geometry instead of full 124M")
     ap.add_argument("--attn", choices=["auto", "dense", "flash"],

@@ -49,11 +49,22 @@ def main() -> None:
     )
 
     initialize()
-    # data absorbs any devices not used by the context axis (specs below
-    # replicate over data, so they stay idle — fine for a kernel bench)
-    mesh = build_mesh(MeshSpec(data=-1, context=args.context))
+    # context=-1 takes every device; otherwise data absorbs the rest
+    # (specs below replicate over data, so those devices stay idle — fine
+    # for a kernel bench). MeshSpec allows only one -1 axis.
+    if args.context == -1:
+        mesh = build_mesh(MeshSpec(data=1, context=-1))
+    else:
+        mesh = build_mesh(MeshSpec(data=-1, context=args.context))
     dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
 
+    n_ctx = mesh.shape["context"]
+    if (args.seq_len // n_ctx) % 128:
+        raise SystemExit(
+            f"--seq-len {args.seq_len} over context={n_ctx} gives per-device "
+            f"seq {args.seq_len // n_ctx}, not a multiple of the kernel's "
+            "128 block; raise --seq-len or lower --context"
+        )
     r = np.random.RandomState(0)
     q = jnp.asarray(
         r.randn(args.batch, args.seq_len, args.heads, args.head_dim), dtype

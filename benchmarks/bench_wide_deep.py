@@ -18,7 +18,7 @@ from benchmarks.common import device_setup, report, time_steps
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--global-batch", type=int, default=8192)
-    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--steps", type=int, default=500)
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 

@@ -70,7 +70,13 @@ def test_conv_flops():
 
 
 def test_kwargs_reach_fn():
-    def f(a, b, *, transpose=False):
-        return a @ (b if not transpose else b)
+    def f(a, b, *, twice=False):
+        y = a @ b
+        return (y @ B_.T) if twice else y  # twice=True does a 2nd matmul
 
-    assert traced_matmul_flops(f, A, B_, transpose=True) == 2 * 8 * 16 * 32
+    one = 2 * 8 * 16 * 32
+    second = 2 * 8 * 32 * 16
+    # the kwarg must reach fn (not be swallowed by make_jaxpr): with
+    # twice=True the count reflects BOTH matmuls
+    assert traced_matmul_flops(f, A, B_, twice=True) == one + second
+    assert traced_matmul_flops(f, A, B_) == one
