@@ -59,6 +59,63 @@ class DistConfig:
 _initialized = False
 
 
+def ensure_platform_from_env(*, strict: bool = True) -> None:
+    """Re-assert JAX_PLATFORMS / JAX_NUM_CPU_DEVICES from the environment.
+
+    ``JAX_PLATFORMS=cpu python script.py`` is NOT sufficient on a machine
+    with an out-of-tree PJRT plugin: plugin registration during ``import
+    jax`` can override the requested platform via ``jax.config`` (config
+    beats env), silently routing a "CPU" run to the accelerator — or
+    hanging it when the accelerator transport is down. Measured on the
+    axon-tunnel chip: only a post-import ``jax.config.update`` reliably
+    pins the platform. JAX_NUM_CPU_DEVICES is re-asserted for the same
+    reason (jax reads it as a flag default at import; the launcher sets it).
+
+    Precedence: the environment wins over an in-process
+    ``jax.config.update`` made before this call (matching the established
+    behavior of the env-driven multi-host path). A caller that wants a
+    programmatic platform choice to survive should not export
+    JAX_PLATFORMS, or should re-apply its choice after initialize().
+    Applied changes are logged at INFO so the reroute is visible.
+
+    ``strict=False`` degrades an un-applicable update (a backend is already
+    live) to a debug log — for opportunistic callers like the single-process
+    path of :func:`initialize`, which must stay a no-op for callers that
+    already touched devices.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    ndev = os.environ.get("JAX_NUM_CPU_DEVICES")
+    try:
+        if plat and jax.config.jax_platforms != plat:
+            log.info(
+                "honoring JAX_PLATFORMS=%s from env (was %r in config)",
+                plat, jax.config.jax_platforms,
+            )
+            jax.config.update("jax_platforms", plat)
+        if ndev and jax.config.jax_num_cpu_devices != int(ndev):
+            jax.config.update("jax_num_cpu_devices", int(ndev))
+    except ValueError as e:
+        # Malformed JAX_NUM_CPU_DEVICES (e.g. "4,4"): name the env var
+        # in strict mode; best-effort callers ignore it like any other
+        # un-applicable setting.
+        if strict:
+            raise ValueError(
+                f"JAX_NUM_CPU_DEVICES={ndev!r} is not an integer"
+            ) from e
+        log.debug("platform env not applied (malformed): %s", e)
+    except RuntimeError as e:
+        if strict:
+            raise RuntimeError(
+                "initialize() must run before any JAX backend is used: the "
+                "environment requests JAX_PLATFORMS/JAX_NUM_CPU_DEVICES "
+                "settings that cannot be applied after jax.devices() (or any "
+                "computation) has initialized a backend. Call "
+                "distributed_tensorflow_guide_tpu.core.dist.initialize() "
+                "first, or clear those env vars."
+            ) from e
+        log.debug("platform env not applied (backend already live): %s", e)
+
+
 def initialize(config: DistConfig | None = None) -> None:
     """Idempotent multi-host init. No-op for single-process runs.
 
@@ -88,36 +145,20 @@ def initialize(config: DistConfig | None = None) -> None:
     if (coord is None and nproc is None and not multi_host_tpu) or (
         coord is None and nproc == 1
     ):
+        # Single-process: still honor an env-requested platform, best-effort
+        # (strict would break callers that already touched devices — those
+        # keep the historical pure-no-op behavior). This is what makes
+        # ``JAX_PLATFORMS=cpu python examples/non_distributed.py`` actually
+        # run on CPU instead of being silently rerouted by the plugin.
+        if not explicit:
+            ensure_platform_from_env(strict=False)
         log.debug("single-process run; skipping jax.distributed.initialize")
         return
-    # Re-assert the env-requested platform/device-count post-import: PJRT
-    # plugins (e.g. the local axon TPU plugin) can override JAX_PLATFORMS
-    # during `import jax`, and JAX_NUM_CPU_DEVICES is this framework's env
-    # convention (the launcher sets it), not a flag JAX reads itself. Done
-    # only on the env-driven multi-host path: single-process calls stay pure
-    # no-ops (config.update raises once backends are live), and an explicit
-    # config keeps its no-env-leakage guarantee (comment above).
+    # Env-driven multi-host path: the platform env MUST apply (the launcher
+    # depends on it), so failures raise with an actionable message. An
+    # explicit config keeps its no-env-leakage guarantee (comment above).
     if not explicit:
-        # config.update raises RuntimeError once any backend is live (e.g.
-        # user code touched jax.devices() before calling initialize()). Skip
-        # updates that already match, and turn the remaining failure into an
-        # actionable message instead of a bare RuntimeError.
-        plat = os.environ.get("JAX_PLATFORMS")
-        ndev = os.environ.get("JAX_NUM_CPU_DEVICES")
-        try:
-            if plat and jax.config.jax_platforms != plat:
-                jax.config.update("jax_platforms", plat)
-            if ndev and jax.config.jax_num_cpu_devices != int(ndev):
-                jax.config.update("jax_num_cpu_devices", int(ndev))
-        except RuntimeError as e:
-            raise RuntimeError(
-                "initialize() must run before any JAX backend is used: the "
-                "environment requests JAX_PLATFORMS/JAX_NUM_CPU_DEVICES "
-                "settings that cannot be applied after jax.devices() (or any "
-                "computation) has initialized a backend. Call "
-                "distributed_tensorflow_guide_tpu.core.dist.initialize() "
-                "first, or clear those env vars."
-            ) from e
+        ensure_platform_from_env(strict=True)
     kwargs = {}
     if coord is not None:
         kwargs["coordinator_address"] = coord

@@ -33,11 +33,18 @@ def train(steps: int, global_batch: int, lr: float, seed: int = 0,
     import optax
     from flax.training import train_state
 
+    from distributed_tensorflow_guide_tpu.core.dist import (
+        ensure_platform_from_env,
+    )
     from distributed_tensorflow_guide_tpu.data.synthetic import synthetic_mnist
     from distributed_tensorflow_guide_tpu.models.mnist_cnn import (
         MNISTCNN,
         make_loss_fn,
     )
+
+    # JAX_PLATFORMS=cpu must mean CPU: the local PJRT plugin overrides the
+    # env during import, so re-assert it before the first device touch.
+    ensure_platform_from_env(strict=False)
 
     model = MNISTCNN()
     params = model.init(
@@ -78,6 +85,9 @@ def main() -> None:
 
     ms = train(args.steps, args.global_batch, args.lr, args.seed,
                args.log_every)
+    import jax
+
+    print(f"platform: {jax.default_backend()} ({jax.device_count()} devices)")
     print(f"done: {len(ms)} steps, final loss {ms[-1]['loss']:.4f}, "
           f"final accuracy {ms[-1]['accuracy']:.3f}")
 

@@ -26,6 +26,12 @@ def test_long_context_sp_example():
 
 
 def test_non_distributed_control_example():
+    # Deliberately the production-shaped environment: the accelerator
+    # plugin's env vars stay set, only JAX_PLATFORMS requests cpu. The
+    # platform assertion below is the regression check that the example
+    # re-asserts the env post-import (core.dist.ensure_platform_from_env) —
+    # without it the plugin silently reroutes this "CPU" run to the
+    # accelerator (and hangs it when the accelerator transport is down).
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     r = subprocess.run(
@@ -35,3 +41,4 @@ def test_non_distributed_control_example():
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "done: 5 steps" in r.stdout
+    assert "platform: cpu" in r.stdout, r.stdout
