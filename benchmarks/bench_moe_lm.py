@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Switch-MoE LM training throughput (models/moe_lm.py SwitchLM).
+
+The EP model family's number of record: tokens/sec for the full causal
+Switch-MoE train step — router, capacity dispatch, dual all_to_all, expert
+FFNs, aux losses, psum'd update — on the real chip (expert axis 1: the
+all_to_all degenerates but every other op is the production path) or on a
+fake mesh with a real expert axis for the sharded schema check.
+
+    python benchmarks/bench_moe_lm.py                      # real chip
+    python benchmarks/bench_moe_lm.py --fake-devices 8 --expert 4 ...
+"""
+
+import argparse
+import sys
+import types
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.common import device_setup, report, time_steps  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fake-devices", type=int, default=0)
+    ap.add_argument("--expert", type=int, default=1,
+                    help="expert-axis size (data absorbs the rest)")
+    ap.add_argument("--num-experts", type=int, default=8)
+    ap.add_argument("--top-k", type=int, default=1)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--d-ff", type=int, default=3072)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--vocab", type=int, default=50304)
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--global-batch", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=30)
+    args = ap.parse_args()
+
+    device_setup(args.fake_devices)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_guide_tpu.core.dist import initialize
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.models.moe_lm import SwitchLM
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        TransformerConfig,
+    )
+
+    initialize()
+    mesh = build_mesh(MeshSpec(data=-1, expert=args.expert))
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    cfg = TransformerConfig(
+        vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
+        d_model=args.d_model, d_ff=args.d_ff, max_len=args.seq_len,
+        causal=True, dtype=dtype,
+    )
+    lm = SwitchLM(mesh, cfg, args.num_experts, top_k=args.top_k)
+    params = lm.init_params(jax.random.PRNGKey(0))
+    tx = optax.adam(1e-4)
+    opt_state = lm.init_opt_state(tx, params)
+    raw_step = lm.make_train_step(tx, params)
+
+    # Adapt (opt_state, params, tokens) -> the (state, batch) shape the
+    # shared timing fence expects (it fences .params and .opt_state).
+    def step(state, tokens):
+        opt_state, params, mets = raw_step(state.opt_state, state.params,
+                                           tokens)
+        return types.SimpleNamespace(opt_state=opt_state, params=params), mets
+
+    state = types.SimpleNamespace(opt_state=opt_state, params=params)
+    rng = np.random.RandomState(0)
+    tokens = jax.device_put(
+        rng.randint(0, cfg.vocab_size,
+                    (args.global_batch, args.seq_len)).astype(np.int32),
+        NamedSharding(mesh, P(("data", "expert"))),
+    )
+
+    dt, _ = time_steps(step, state, tokens, warmup=3, steps=args.steps)
+    toks = args.global_batch * args.seq_len * args.steps
+    report("switch_moe_lm_throughput", toks / dt, "tokens/sec")
+
+
+if __name__ == "__main__":
+    main()

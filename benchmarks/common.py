@@ -33,7 +33,11 @@ def device_setup(fake_devices: int = 0) -> None:
     on a different machine can SIGILL on feature mismatch).
     """
     if fake_devices:
+        # Export BOTH vars so later env re-asserts (core.dist.initialize →
+        # ensure_platform_from_env) agree with the config set here — an
+        # ambient JAX_NUM_CPU_DEVICES must not clobber the requested count.
         os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["JAX_NUM_CPU_DEVICES"] = str(fake_devices)
     import jax
 
     if fake_devices:

@@ -21,6 +21,8 @@ BENCHES = [
     "bench_gpt2_pp.py",       # config 5
     "bench_native_input.py",  # config 1 fed from the C++ record loader
     "bench_ring_attention.py",  # long-context SP: Pallas kernel vs XLA path
+    "bench_moe_lm.py",        # EP model family: Switch-MoE LM tokens/sec
+    "bench_fsdp_memory.py",   # FSDP: per-device state bytes vs replicated DP
 ]
 
 # Tiny fake-device configs, small enough for CPU (also used by
@@ -43,6 +45,15 @@ SMOKE = {
     "bench_ring_attention.py":
         ["--fake-devices", "8", "--context", "4", "--seq-len", "512",
          "--batch", "1", "--heads", "2", "--head-dim", "16", "--iters", "2"],
+    "bench_moe_lm.py":
+        ["--fake-devices", "8", "--expert", "4", "--num-experts", "8",
+         "--layers", "2", "--d-model", "64", "--d-ff", "128", "--heads", "4",
+         "--vocab", "256", "--seq-len", "32", "--global-batch", "16",
+         "--steps", "2"],
+    "bench_fsdp_memory.py":
+        ["--fake-devices", "8", "--layers", "2", "--d-model", "64",
+         "--d-ff", "128", "--heads", "4", "--vocab", "256",
+         "--seq-len", "32", "--global-batch", "8", "--steps", "1"],
 }
 
 

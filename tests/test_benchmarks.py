@@ -35,7 +35,10 @@ def test_bench_smoke(script, args):
     assert r.returncode == 0, r.stdout + r.stderr
     line = r.stdout.strip().splitlines()[-1]
     result = json.loads(line)
-    assert set(result) == {"metric", "value", "unit", "vs_baseline"}
+    # the contract keys must be present; benches may add evidence keys
+    # (bench.py itself adds trials/spread_pct, fsdp_memory adds the
+    # replicated-DP comparison)
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
     assert result["value"] > 0
 
 
