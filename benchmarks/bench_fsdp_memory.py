@@ -95,7 +95,10 @@ def main() -> None:
     state = jax.device_put(state, st_sh)
     n_params = sum(l.size for l in jax.tree.leaves(state.params))
 
-    # Prove the sharded layout executes, not just materializes.
+    # Prove the sharded layout executes, not just materializes. AOT-compile
+    # once: the same executable serves the step loop and the peak-memory
+    # query (a second jit-triggered compile would double the bench's
+    # dominant cost on fake CPU, where the persistent cache is off).
     step = fsdp.make_train_step(make_lm_loss_fn(model), st_sh)
     rng = np.random.RandomState(0)
     batch = {
@@ -105,9 +108,10 @@ def main() -> None:
             NamedSharding(mesh, P("data")),
         )
     }
+    compiled = step.lower(state, batch).compile()
     loss = None
     for _ in range(args.steps):
-        state, mets = step(state, batch)
+        state, mets = compiled(state, batch)
         loss = float(mets["loss"])
 
     sharded_mb = state_bytes(state, sharded=True) / 2**20
@@ -116,7 +120,7 @@ def main() -> None:
     # Peak-memory view from the compiler, where the backend reports one.
     peak_mb = None
     try:
-        mem = step.lower(state, batch).compile().memory_analysis()
+        mem = compiled.memory_analysis()
         peak = getattr(mem, "temp_size_in_bytes", None)
         if peak:
             peak_mb = round(peak / 2**20, 1)

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Sequence-parallel communication accounting: ring vs Ulysses ICI traffic.
+
+The two SP layouts (parallel/sequence.py) trade communication *shape*:
+
+* ring: 2 ppermute call sites inside the KV-rotation scan — each executed
+  rotation moves the full local K and V shards one ICI hop, n times, so the
+  executed wire traffic per device per forward is ``2 * n * T`` where
+  ``T = B * (S/n) * H * D * itemsize`` — i.e. ``2 * B*S*H*D`` bytes total,
+  independent of the ring size, all of it neighbor-hop traffic.
+* Ulysses: 4 all_to_all call sites (q/k/v in, output back) — each moves
+  ``(n-1)/n`` of the local tensor across the fabric once, so the executed
+  wire traffic is ``4 * T * (n-1)/n`` ≈ ``4 * B*(S/n)*H*D`` bytes — n/2×
+  less than ring, but as transpose (all-pairs) traffic rather than
+  neighbor hops, and only legal when n divides the head count.
+
+This bench *measures* those counts with ``collectives.trace_comm`` (the
+framework's NCCL-trace equivalent) by lowering the real shard_map programs
+on a fake mesh, then reports the executed per-device forward bytes. The
+traced-vs-analytic identity is pinned in tests/test_sp_comm.py. Scope is
+the forward pass: backward collectives created by autodiff transposes
+(lax.ppermute's transpose rule) bypass the wrapper layer by design.
+
+    python benchmarks/bench_sp_comm.py --fake-devices 8 --context 8
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.common import device_setup  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fake-devices", type=int, default=8)
+    ap.add_argument("--context", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--seq-len", type=int, default=4096)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--head-dim", type=int, default=64)
+    args = ap.parse_args()
+
+    device_setup(args.fake_devices)
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    import distributed_tensorflow_guide_tpu.collectives as cc
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.parallel.sequence import (
+        ring_attention,
+        ulysses_attention,
+    )
+
+    mesh = build_mesh(MeshSpec(data=-1, context=args.context))
+    n = args.context
+    if args.seq_len % n or args.heads % n:
+        raise SystemExit(
+            f"--seq-len {args.seq_len} and --heads {args.heads} must be "
+            f"divisible by --context {n} (ring shards seq; Ulysses also "
+            "reshards heads)"
+        )
+    # global array; shard_map hands each device a (B, S/n, H, D) shard
+    x = jnp.zeros((args.batch, args.seq_len, args.heads, args.head_dim),
+                  jnp.float32)
+    shard_shape = (args.batch, args.seq_len // n, args.heads, args.head_dim)
+
+    def lower(fn):
+        """Trace the sharded program; trace_comm records per-device shard
+        bytes at each wrapper call site."""
+        sm = jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(None, "context"),) * 3,
+            out_specs=P(None, "context"),
+            check_vma=False,
+        )
+        with cc.trace_comm() as rec:
+            jax.jit(sm).lower(x, x, x)
+        return rec
+
+    ring = lower(functools.partial(ring_attention, causal=True, impl="xla"))
+    uly = lower(functools.partial(ulysses_attention, causal=True,
+                                  impl="dense"))
+
+    t_bytes = int(np.prod(shard_shape)) * 4  # one local f32 q/k/v shard
+    ring_site = ring.bytes["ppermute[context]"]
+    uly_site = uly.bytes["all_to_all[context]"]
+    # executed wire bytes per device per forward (see module docstring)
+    ring_wire = ring_site * n                 # 2 sites * T, n rotations
+    uly_wire = uly_site * (n - 1) // n        # 4 sites * T, one transpose
+
+    print(json.dumps({
+        "metric": "sp_forward_ici_bytes_per_device",
+        "value": round(ring_wire / 2**20, 3),
+        "unit": "MB (ring)",
+        "vs_baseline": None,
+        "ring_mb": round(ring_wire / 2**20, 3),
+        "ulysses_mb": round(uly_wire / 2**20, 3),
+        "ring_over_ulysses": round(ring_wire / uly_wire, 2),
+        "ring_ppermute_sites": ring.calls["ppermute[context]"],
+        "ulysses_all_to_all_sites": uly.calls["all_to_all[context]"],
+        "local_shard_mb": round(t_bytes / 2**20, 3),
+        "context": n,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,7 @@ BENCHES = [
     "bench_ring_attention.py",  # long-context SP: Pallas kernel vs XLA path
     "bench_moe_lm.py",        # EP model family: Switch-MoE LM tokens/sec
     "bench_fsdp_memory.py",   # FSDP: per-device state bytes vs replicated DP
+    "bench_sp_comm.py",       # SP layouts: ring vs Ulysses ICI traffic
 ]
 
 # Tiny fake-device configs, small enough for CPU (also used by
@@ -54,6 +55,9 @@ SMOKE = {
         ["--fake-devices", "8", "--layers", "2", "--d-model", "64",
          "--d-ff", "128", "--heads", "4", "--vocab", "256",
          "--seq-len", "32", "--global-batch", "8", "--steps", "1"],
+    "bench_sp_comm.py":
+        ["--fake-devices", "8", "--context", "4", "--seq-len", "256",
+         "--heads", "8", "--head-dim", "16"],
 }
 
 

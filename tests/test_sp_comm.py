@@ -1,0 +1,66 @@
+"""Communication accounting for the SP layouts — traced-vs-analytic parity.
+
+Pins the identity benchmarks/bench_sp_comm.py relies on: tracing the real
+ring / Ulysses shard_map programs under ``collectives.trace_comm`` yields
+exactly the call sites and per-device shard bytes the designs predict
+(SURVEY.md §5 long-context row; ring = Liu et al. blockwise + KV rotation,
+Ulysses = Jacobs et al. all_to_all head-resharding)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+from distributed_tensorflow_guide_tpu.parallel.sequence import (
+    ring_attention,
+    ulysses_attention,
+)
+
+B, S, H, D = 2, 512, 8, 32
+
+
+@pytest.fixture()
+def ctx_mesh():
+    return build_mesh(MeshSpec(data=-1, context=4))
+
+
+def _lower(mesh, fn):
+    # global (B, S, H, D); shard_map hands each device (B, S/4, H, D)
+    x = jnp.zeros((B, S, H, D), jnp.float32)
+    sm = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(None, "context"),) * 3,
+        out_specs=P(None, "context"),
+        check_vma=False,
+    )
+    with cc.trace_comm() as rec:
+        jax.jit(sm).lower(x, x, x)
+    local_bytes = int(np.prod((B, S // 4, H, D))) * 4
+    return rec, local_bytes
+
+
+def test_ring_comm_sites(ctx_mesh):
+    rec, t = _lower(
+        ctx_mesh, functools.partial(ring_attention, causal=True, impl="xla")
+    )
+    # one K + one V ppermute site inside the rotation scan, each a full
+    # local shard; executed n times per step (the scan body traces once)
+    assert rec.calls["ppermute[context]"] == 2
+    assert rec.bytes["ppermute[context]"] == 2 * t
+    assert rec.calls.get("all_to_all[context]", 0) == 0
+
+
+def test_ulysses_comm_sites(ctx_mesh):
+    rec, t = _lower(
+        ctx_mesh,
+        functools.partial(ulysses_attention, causal=True, impl="dense"),
+    )
+    # q/k/v reshard seq->heads plus the output's heads->seq return trip
+    assert rec.calls["all_to_all[context]"] == 4
+    assert rec.bytes["all_to_all[context]"] == 4 * t
+    assert rec.calls.get("ppermute[context]", 0) == 0
