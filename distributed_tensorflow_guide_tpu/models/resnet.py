@@ -61,6 +61,11 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     small_inputs: bool = False  # skip the stride-4 stem for <=64px images
+    # Rematerialize each bottleneck block in the backward pass: stores only
+    # block-boundary activations, trading conv re-FLOPs (cheap — the step is
+    # HBM-bound, docs/performance.md roofline) for resident HBM, to admit
+    # larger per-chip batches without spilling. Numerically identical.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -84,15 +89,21 @@ class ResNet(nn.Module):
         x = nn.relu(x)
         if not self.small_inputs:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block_cls = nn.remat(BottleneckBlock) if self.remat else BottleneckBlock
+        k = 0
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = BottleneckBlock(
+                # explicit name pins the param-tree path to the historical
+                # auto-name, so remat=True/False share one parameter layout
+                x = block_cls(
                     self.num_filters * 2**i,
                     strides=strides,
                     conv=conv,
                     norm=norm,
+                    name=f"BottleneckBlock_{k}",
                 )(x)
+                k += 1
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
         return x
