@@ -253,31 +253,53 @@ def test_mnist_topology_determinism_gate():
 
     STEPS, BATCH, LR, SEED = 5, 32, 0.05, 0
 
-    def dp_train(spec_accum, seed: int):
-        spec, accum = spec_accum
+    def dp_train(topo, seed: int):
+        spec, accum, kind = topo
         mesh = build_mesh(spec, devices=jax.devices()[:spec.data])
-        dp = DataParallel(mesh)
         model = MNISTCNN()
         params = model.init(
             jax.random.PRNGKey(seed), jnp.zeros((1, 28, 28, 1))
         )["params"]
-        state = dp.replicate(train_state.TrainState.create(
-            apply_fn=model.apply, params=params,
-            tx=optax.sgd(LR, momentum=0.9),
-        ))
-        step = dp.make_train_step(make_loss_fn(model), donate=False,
-                                  accum_steps=accum)
+        loss_fn = make_loss_fn(model)
+        if kind == "fsdp":
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from distributed_tensorflow_guide_tpu.parallel.fsdp import FSDP
+
+            fsdp = FSDP(mesh, min_shard_size=2 ** 10)
+            params, shardings = fsdp.init_params(lambda: params)
+            state = train_state.TrainState.create(
+                apply_fn=model.apply, params=params,
+                tx=optax.sgd(LR, momentum=0.9),
+            )
+            st_sh = fsdp.state_shardings(state, shardings)
+            state = jax.device_put(state, st_sh)
+            step = fsdp.make_train_step(loss_fn, st_sh, donate=False)
+            shard = lambda b: jax.device_put(  # noqa: E731
+                b, NamedSharding(mesh, P("data"))
+            )
+        else:
+            dp = DataParallel(mesh)
+            state = dp.replicate(train_state.TrainState.create(
+                apply_fn=model.apply, params=params,
+                tx=optax.sgd(LR, momentum=0.9),
+            ))
+            step = dp.make_train_step(loss_fn, donate=False,
+                                      accum_steps=accum)
+            shard = dp.shard_batch
         out = []
         for b in synthetic_mnist(BATCH, seed=seed).take(STEPS):
-            state, m = step(state, dp.shard_batch(b))
+            state, m = step(state, shard(b))
             out.append({k: float(v) for k, v in m.items()})
         return out
 
     # same seed, same global batch; topologies: full-mesh DP, 2-way DP,
-    # and 4-way DP with 2-step gradient accumulation (mean-of-means ==
-    # full-batch mean at equal microbatch sizes)
-    specs = [(MeshSpec(data=8), 1), (MeshSpec(data=2), 1),
-             (MeshSpec(data=4), 2)]
+    # 4-way DP with 2-step gradient accumulation (mean-of-means ==
+    # full-batch mean at equal microbatch sizes), and fully-sharded
+    # (ZeRO-3) over 8 — an execution-layout change that must not move
+    # the numbers
+    specs = [(MeshSpec(data=8), 1, "dp"), (MeshSpec(data=2), 1, "dp"),
+             (MeshSpec(data=4), 2, "dp"), (MeshSpec(data=8), 1, "fsdp")]
     rep = check_topologies(dp_train, specs, seed=SEED, rtol=1e-4)
     rep.raise_if_failed()
 
