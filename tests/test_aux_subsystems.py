@@ -267,7 +267,8 @@ def test_mnist_topology_determinism_gate():
             from distributed_tensorflow_guide_tpu.parallel.fsdp import FSDP
 
             fsdp = FSDP(mesh, min_shard_size=2 ** 10)
-            params, shardings = fsdp.init_params(lambda: params)
+            shardings = fsdp.param_shardings(params)
+            params = jax.device_put(params, shardings)
             state = train_state.TrainState.create(
                 apply_fn=model.apply, params=params,
                 tx=optax.sgd(LR, momentum=0.9),

@@ -108,9 +108,6 @@ def test_sharded_fsdp_roundtrip(tmp_path):
     """Sharding-aware checkpointing (SURVEY.md §5 checkpoint row): an FSDP
     (ZeRO-3) state saves from its shards and restores INTO its shards — the
     multi-host recovery path where no device ever holds the full tree."""
-    import optax
-    from flax.training import train_state
-
     from distributed_tensorflow_guide_tpu.core.mesh import (
         MeshSpec,
         build_mesh,
@@ -138,10 +135,10 @@ def test_sharded_fsdp_roundtrip(tmp_path):
     ckpt.wait()
 
     restored = ckpt.restore(state)
-    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
-        if hasattr(a, "sharding"):
-            assert a.sharding == b.sharding, (a.sharding, b.sharding)
-            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored),
+                    strict=True):
+        assert a.sharding == b.sharding, (a.sharding, b.sharding)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # the big kernel really is sharded in the restored tree
     big = max(jax.tree.leaves(restored.params), key=lambda l: l.size)
     assert "data" in tuple(s for s in big.sharding.spec if s)

@@ -42,3 +42,15 @@ def test_non_distributed_control_example():
     assert r.returncode == 0, r.stdout + r.stderr
     assert "done: 5 steps" in r.stdout
     assert "platform: cpu" in r.stdout, r.stdout
+
+
+def test_fsdp_zero3_example():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "fsdp_zero3.py"),
+         "--fake-devices", "8", "--steps", "12", "--global-batch", "8"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "local shard = 0.125" in r.stdout, r.stdout
