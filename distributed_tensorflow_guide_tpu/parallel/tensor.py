@@ -28,6 +28,18 @@ from jax.sharding import PartitionSpec as P
 from distributed_tensorflow_guide_tpu.utils.spec_utils import assign_by_shape
 
 # logical axis name -> mesh axis (None = replicated)
+#
+# Scope note (measured, this flax/jax version): under the legacy `with
+# mesh:` trace context this strategy must use (see make_train_step), the
+# model's nn.with_logical_constraint activation annotations are advisory —
+# compiled HLO is identical with or without them; GSPMD derives the layout
+# entirely from the param shardings and the step's in/out shardings. The
+# modern jax.set_mesh context would make them binding, but it breaks
+# flax's DenseGeneral + with_logical_partitioning boxing (rank-2 flat
+# kernel vs rank-4 logical names — fails at param unboxing), so
+# Megatron-style residual-stream sequence sharding is not expressible
+# here without model surgery; the ``context`` axis (parallel/sequence.py)
+# is this framework's sequence-sharding mechanism instead.
 DEFAULT_RULES = (
     ("batch", "data"),
     ("seq", None),       # sequence stays unsharded under pure TP; the
@@ -115,4 +127,7 @@ class TensorParallel:
             with self.mesh:
                 return jitted(state, batch)
 
+        # expose the raw jitted step for AOT consumers (lower/compile/
+        # memory_analysis) — the wrapper itself is a plain function
+        step_in_mesh.jitted = jitted
         return step_in_mesh
