@@ -1,0 +1,42 @@
+"""Unit contract of core.dist.ensure_platform_from_env.
+
+The subprocess-level behavior (a "CPU" example actually landing on CPU
+with the accelerator plugin registered) is covered by
+tests/test_examples.py::test_non_distributed_control_example; these pin
+the helper's error handling, which only manifests once a backend is live —
+exactly the state an in-process pytest run is in (conftest touched
+devices).
+"""
+
+import jax
+import pytest
+
+from distributed_tensorflow_guide_tpu.core.dist import (
+    ensure_platform_from_env,
+)
+
+
+def test_noop_when_env_matches(monkeypatch, devices):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("JAX_NUM_CPU_DEVICES", str(len(devices)))
+    ensure_platform_from_env(strict=True)  # matching values: no update, no raise
+
+
+def test_strict_names_malformed_device_count(monkeypatch):
+    monkeypatch.setenv("JAX_NUM_CPU_DEVICES", "4,4")
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    with pytest.raises(ValueError, match="JAX_NUM_CPU_DEVICES"):
+        ensure_platform_from_env(strict=True)
+    ensure_platform_from_env(strict=False)  # best-effort swallows it
+
+
+def test_strict_raises_actionable_after_backend_live(monkeypatch, devices):
+    # the devices fixture guarantees a live CPU backend (required even when
+    # this test runs in isolation), so a conflicting request cannot be
+    # applied; strict mode must say what to do about it
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("JAX_NUM_CPU_DEVICES", "3")  # != the live 8
+    with pytest.raises(RuntimeError, match="initialize\\(\\) must run"):
+        ensure_platform_from_env(strict=True)
+    ensure_platform_from_env(strict=False)  # best-effort degrades to a log
+    assert jax.device_count() == 8  # nothing changed
