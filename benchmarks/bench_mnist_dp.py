@@ -18,6 +18,11 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--global-batch", type=int, default=1024)
     ap.add_argument("--steps", type=int, default=500)
+    # >1 scans that many optimizer steps per dispatch (synthetic mode: same
+    # batch each inner step) — the TF steps_per_run knob; worth A/B-ing for
+    # millisecond-step models on the high-latency tunnel. Echoed in the
+    # JSON when set, so an A/B run is distinguishable from the judged config.
+    ap.add_argument("--steps-per-call", type=int, default=1)
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -46,7 +51,8 @@ def main() -> None:
                         jnp.zeros((1, 28, 28, 1)))["params"]
     state = dp.replicate(train_state.TrainState.create(
         apply_fn=model.apply, params=params, tx=optax.sgd(0.05)))
-    step = dp.make_train_step(make_loss_fn(model))
+    step = dp.make_train_step(make_loss_fn(model),
+                              steps_per_call=args.steps_per_call)
 
     r = np.random.RandomState(0)
     batch = dp.shard_batch({
@@ -54,8 +60,10 @@ def main() -> None:
         "label": r.randint(0, 10, args.global_batch).astype(np.int32),
     })
     dt, _ = time_steps(step, state, batch, steps=args.steps)
-    report("mnist_cnn_sync_dp_throughput",
-           args.global_batch * args.steps / dt, "images/sec")
+    images = args.global_batch * args.steps * args.steps_per_call
+    extra = ({} if args.steps_per_call == 1
+             else {"steps_per_call": args.steps_per_call})
+    report("mnist_cnn_sync_dp_throughput", images / dt, "images/sec", **extra)
 
 
 if __name__ == "__main__":

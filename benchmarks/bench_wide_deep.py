@@ -19,6 +19,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--global-batch", type=int, default=8192)
     ap.add_argument("--steps", type=int, default=500)
+    # see bench_mnist_dp.py: the TF steps_per_run knob, echoed when set
+    ap.add_argument("--steps-per-call", type=int, default=1)
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -53,11 +55,15 @@ def main() -> None:
     dp = DataParallel(mesh)
     state = dp.replicate(train_state.TrainState.create(
         apply_fn=model.apply, params=params, tx=optax.adam(1e-3)))
-    step = dp.make_train_step(make_loss_fn(model))
+    step = dp.make_train_step(make_loss_fn(model),
+                              steps_per_call=args.steps_per_call)
     batch = dp.shard_batch(b0)
     dt, _ = time_steps(step, state, batch, steps=args.steps)
-    report("wide_deep_sync_dp_throughput",
-           args.global_batch * args.steps / dt, "examples/sec")
+    examples = args.global_batch * args.steps * args.steps_per_call
+    extra = ({} if args.steps_per_call == 1
+             else {"steps_per_call": args.steps_per_call})
+    report("wide_deep_sync_dp_throughput", examples / dt, "examples/sec",
+           **extra)
 
 
 if __name__ == "__main__":

@@ -131,11 +131,17 @@ def time_steps(
 
 
 def report(metric: str, value: float, unit: str,
-           baseline: float | None = None) -> None:
-    """Print the single JSON result line."""
+           baseline: float | None = None, **extra) -> None:
+    """Print the single JSON result line.
+
+    ``extra`` keys are appended after the four contract keys — benches use
+    them to mark non-judged configurations (e.g. ``steps_per_call=8``) so
+    an A/B run can never be mistaken for the number of record.
+    """
     print(json.dumps({
         "metric": metric,
         "value": round(value, 1),
         "unit": unit,
         "vs_baseline": round(value / baseline, 3) if baseline else None,
+        **extra,
     }))

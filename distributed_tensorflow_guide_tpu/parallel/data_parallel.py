@@ -91,6 +91,10 @@ class DataParallel:
         re-used every inner step (synthetic benchmarking mode). Metrics
         returned are the LAST inner step's.
         """
+        if steps_per_call < 1:
+            raise ValueError(
+                f"steps_per_call must be >= 1, got {steps_per_call}"
+            )
         if steps_per_call == 1:
             if stacked_batch:
                 raise ValueError(
