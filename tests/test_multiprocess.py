@@ -97,6 +97,59 @@ def _target_dp_local_shards(steps):
             "w": np.asarray(state.params["w"]).tolist()}
 
 
+def _target_fsdp_sharded_step(steps):
+    """GSPMD param-sharded (ZeRO-3) TRAINING spanning processes: params and
+    moments live in NamedSharding shards across both processes' devices —
+    the multi-controller capability shard_map collectives alone don't prove."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax.training import train_state
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.parallel.fsdp import FSDP
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    fsdp = FSDP(mesh, min_shard_size=4)
+
+    def init_fn():
+        return {"w": jnp.zeros((8, 4), jnp.float32)}
+
+    params, shardings = fsdp.init_params(init_fn)
+    state = train_state.TrainState.create(
+        apply_fn=None, params=params, tx=optax.sgd(0.1)
+    )
+    st_sh = fsdp.state_shardings(state, shardings)
+    state = jax.device_put(state, st_sh)
+
+    rng = np.random.RandomState(1)
+    gx = rng.randn(8, 8).astype(np.float32)
+    gy = rng.randn(8, 4).astype(np.float32)
+    per = 8 // jax.process_count()
+    lo = jax.process_index() * per
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2), {}
+
+    step = fsdp.make_train_step(loss_fn, st_sh, donate=False)
+    batch = {
+        k: jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("data")), v[lo:lo + per]
+        )
+        for k, v in (("x", gx), ("y", gy))
+    }
+    losses = []
+    for _ in range(steps):
+        state, mets = step(state, batch)
+        losses.append(float(mets["loss"]))
+    w_spec = tuple(state.params["w"].sharding.spec)
+    return {"pid": jax.process_index(), "losses": losses,
+            "w_spec": [str(x) for x in w_spec]}
+
+
 def _target_one_proc_fails():
     import jax
 
@@ -150,6 +203,35 @@ def test_dp_from_process_local_batches_matches_single_process():
     for r in results:
         assert r.result["losses"] == pytest.approx(ref_losses, rel=1e-4)
         assert r.result["w"] == pytest.approx(w.tolist(), rel=1e-4)
+
+
+def test_fsdp_sharded_training_across_processes():
+    """ZeRO-3 across processes matches the single-process trajectory and
+    the params really live sharded over the cross-process data axis."""
+    import numpy as np
+
+    steps = 4
+    results = run_multiprocess(
+        _target_fsdp_sharded_step, N, args=(steps,),
+        local_devices_per_process=2,
+    )
+    assert [r.ok for r in results] == [True] * N
+    for r in results:
+        assert "data" in r.result["w_spec"], r.result
+
+    # single-(this-)process reference on the same problem, plain GD
+    rng = np.random.RandomState(1)
+    gx = rng.randn(8, 8).astype(np.float32)
+    gy = rng.randn(8, 4).astype(np.float32)
+    w = np.zeros((8, 4), np.float32)
+    ref = []
+    for _ in range(steps):
+        pred = gx @ w
+        ref.append(float(np.mean((pred - gy) ** 2)))
+        grad = 2.0 * gx.T @ (pred - gy) / pred.size
+        w -= 0.1 * grad
+    for r in results:
+        np.testing.assert_allclose(r.result["losses"], ref, rtol=1e-4)
 
 
 def test_subprocess_failure_propagates():
