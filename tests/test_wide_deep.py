@@ -110,10 +110,6 @@ def test_wide_deep_fsdp_shards_embedding_tables():
     step_f = fsdp.make_train_step(make_loss_fn(model), st_sh, donate=False)
 
     # replicated-DP reference from the SAME initial params
-    from distributed_tensorflow_guide_tpu.parallel.data_parallel import (
-        DataParallel,
-    )
-
     dp = DataParallel(mesh)
     params_np = jax.tree.map(np.asarray, params)
     state_d = dp.replicate(train_state.TrainState.create(
