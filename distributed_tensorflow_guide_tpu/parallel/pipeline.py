@@ -156,6 +156,77 @@ def _make_1f1b_schedule(M: int, P: int):
             "R": R, "T": T}
 
 
+def _make_interleaved_schedule(M: int, P: int, v: int):
+    """Forward schedule for interleaved GPipe (Megatron virtual stages):
+    D = v*P chunk-stages laid round-robin on P devices (chunk-stage k lives
+    on device k % P as local chunk row j = k // P). One op per device per
+    tick; drain priority (deepest ready chunk first); chunk-stage k of
+    microbatch m runs strictly after k-1 of m. Shrinks the pipeline bubble
+    from (P-1)/(M+P-1) toward (P-1)/(vM+P-1): each fill/drain slot costs a
+    1/v-stage chunk instead of a full stage.
+
+    Returns numpy tables (T, P): ``jrow``/``mbrow`` (op, -1 = idle),
+    ``rflag``/``rj``/``rm`` (landing slot for the activation that arrives
+    this tick), plus ``done[k][m]`` tick stamps and ``T``.
+    """
+    import numpy as np
+
+    D = v * P
+    done = [[-1] * M for _ in range(D)]
+    nxt = [0] * D
+    t = 0
+    ops: list[list[tuple[int, int]]] = []
+    while any(nxt[k] < M for k in range(D)):
+        row = [(-1, -1)] * P
+        for s in range(P):
+            for j in reversed(range(v)):
+                k = j * P + s
+                m = nxt[k]
+                if m >= M:
+                    continue
+                if k == 0 or 0 <= done[k - 1][m] < t:
+                    row[s] = (j, m)
+                    break
+        for s in range(P):
+            j, m = row[s]
+            if j >= 0:
+                done[j * P + s][m] = t
+                nxt[j * P + s] += 1
+        ops.append(row)
+        t += 1
+        if t > 10 * (M * v + P) + 16:  # pragma: no cover - safety
+            raise RuntimeError("interleaved schedule did not converge")
+    T = t
+    jrow = np.full((T, P), -1, np.int32)
+    mbrow = np.zeros((T, P), np.int32)
+    for tt, row in enumerate(ops):
+        for s in range(P):
+            j, m = row[s]
+            jrow[tt, s] = j
+            mbrow[tt, s] = m if j >= 0 else 0
+    # Arrivals: what device s-1 (mod P) ran at t-1 lands on s at t, destined
+    # for chunk-stage k+1 = same local row j (or j+1 when wrapping P-1 -> 0).
+    # The last chunk-stage's output never lands anywhere (it is the tap).
+    rflag = np.zeros((T, P), np.int32)
+    rj = np.zeros((T, P), np.int32)
+    rm = np.zeros((T, P), np.int32)
+    for tt in range(1, T):
+        for s in range(P):
+            sp = (s - 1) % P
+            j, m = ops[tt - 1][sp]
+            if j < 0:
+                continue
+            k_next = j * P + sp + 1
+            if k_next >= D:
+                continue  # tap, not a hand-off
+            assert k_next % P == s
+            rflag[tt, s] = 1
+            rj[tt, s] = k_next // P
+            rm[tt, s] = m
+    return {"jrow": jrow, "mbrow": mbrow, "rflag": rflag, "rj": rj,
+            "rm": rm, "done": done, "T": T}
+
+
 class _Embedder(nn.Module):
     cfg: TransformerConfig
 
@@ -183,7 +254,8 @@ class PipelinedLM:
     """GPipe LM training over the ``pipe`` (× ``data``) mesh axes."""
 
     def __init__(self, mesh: Mesh, cfg: TransformerConfig,
-                 num_microbatches: int, schedule: str = "gpipe"):
+                 num_microbatches: int, schedule: str = "gpipe",
+                 virtual_chunks: int = 1):
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown pipeline schedule {schedule!r}")
         self.mesh = mesh
@@ -193,11 +265,26 @@ class PipelinedLM:
         self.n_stages = sizes["pipe"]
         self.n_data = sizes["data"]
         self.num_microbatches = num_microbatches
-        if cfg.num_layers % self.n_stages:
+        # Interleaved GPipe (Megatron virtual stages): each device holds
+        # ``virtual_chunks`` non-contiguous layer chunks; chunk-stage
+        # k = j*P + s lives on device s as local row j. Fill/drain slots
+        # cost a 1/v stage, shrinking the bubble ~v-fold
+        # (_make_interleaved_schedule). v > 1 is a gpipe-schedule feature
+        # (autodiff produces the reversed drain); 1F1B keeps v = 1.
+        if virtual_chunks < 1:
+            raise ValueError(f"virtual_chunks must be >= 1, got {virtual_chunks}")
+        if virtual_chunks > 1 and schedule != "gpipe":
+            raise ValueError("virtual_chunks > 1 requires schedule='gpipe'")
+        self.virtual_chunks = virtual_chunks
+        n_chunk_stages = self.n_stages * virtual_chunks
+        if cfg.num_layers % n_chunk_stages:
             raise ValueError(
-                f"{cfg.num_layers} layers not divisible by {self.n_stages} stages"
+                f"{cfg.num_layers} layers not divisible by "
+                f"{n_chunk_stages} chunk-stages "
+                f"({self.n_stages} stages x {virtual_chunks} chunks)"
             )
         self.layers_per_stage = cfg.num_layers // self.n_stages
+        self.layers_per_chunk = cfg.num_layers // n_chunk_stages
         self.embedder = _Embedder(cfg)
         self.head = _Head(cfg)
         self.block = Block(cfg)
@@ -214,10 +301,29 @@ class PipelinedLM:
         stacked = jax.vmap(
             lambda k: self.block.init(k, dummy_x)["params"]
         )(keys)
-        stacked = jax.tree.map(
-            lambda x: x.reshape(self.n_stages, self.layers_per_stage, *x.shape[1:]),
-            stacked,
-        )
+        v = self.virtual_chunks
+        if v == 1:
+            stacked = jax.tree.map(
+                lambda x: x.reshape(
+                    self.n_stages, self.layers_per_stage, *x.shape[1:]
+                ),
+                stacked,
+            )
+        else:
+            # interleaved chunk order: global row r = s*v + j (the row the
+            # contiguous pipe-shard hands device s as local row j) holds the
+            # layers of chunk-stage k = j*P + s
+            P_, Lc = self.n_stages, self.layers_per_chunk
+            order = []
+            for r in range(P_ * v):
+                s, j = divmod(r, v)
+                k = j * P_ + s
+                order.extend(range(k * Lc, (k + 1) * Lc))
+            idx = jnp.asarray(order)
+            stacked = jax.tree.map(
+                lambda x: x[idx].reshape(P_ * v, Lc, *x.shape[1:]),
+                stacked,
+            )
         head = self.head.init(r_head, dummy_x)["params"]
         params = {"embed": emb, "stages": stacked, "head": head}
         return jax.device_put(params, self.param_shardings())
@@ -256,6 +362,17 @@ class PipelinedLM:
         flat = tokens_mbs.reshape(M * mb, S)
         e = self.embedder.apply({"params": embed_params}, flat)
         return e.reshape(M, mb, S, self.cfg.d_model).astype(self.cfg.dtype)
+
+    def _head_loss_sum(self, head_params, finals, tokens_mbs):
+        """Sum of per-microbatch head losses — the single implementation
+        both the plain and interleaved GPipe paths dispatch to on the last
+        stage (a scan over microbatches, so logits memory stays at one)."""
+        def body(acc, inp):
+            x, toks = inp
+            return acc + self._mb_loss(head_params, x, toks), None
+
+        total, _ = lax.scan(body, jnp.float32(0.0), (finals, tokens_mbs))
+        return total
 
     def _mb_loss(self, head_params, x, toks):
         """Head + next-token NLL for one microbatch's final activations.
@@ -315,18 +432,10 @@ class PipelinedLM:
         # first P-1 ys are fill ticks on every stage.
         taps = taps[n_stages - 1:]  # (M, mb, S, d_model)
 
-        def head_loss():
-            def body(acc, inp):
-                x, toks = inp
-                return acc + self._mb_loss(params["head"], x, toks), None
-
-            total, _ = lax.scan(
-                body, jnp.float32(0.0), (taps, tokens_mbs)
-            )
-            return total
-
         loss_sum = lax.cond(
-            stage == n_stages - 1, head_loss, lambda: jnp.float32(0.0)
+            stage == n_stages - 1,
+            lambda: self._head_loss_sum(params["head"], taps, tokens_mbs),
+            lambda: jnp.float32(0.0),
         )
         # LOCAL loss: nonzero only on the last stage. Do NOT psum here — the
         # transpose of psum under shard_map is another psum, which would
@@ -335,6 +444,84 @@ class PipelinedLM:
         # ppermute transposes (the backward pipeline). The caller psums the
         # VALUE for reporting.
         return loss_sum / M
+
+    def _pipeline_loss_interleaved(self, params, tokens_mbs):
+        """Interleaved-GPipe forward + LM loss (virtual_chunks > 1).
+
+        Same contract as :meth:`_pipeline_loss` (autodiff produces the
+        reversed drain), with each device cycling through its ``v`` layer
+        chunks per the static table from :func:`_make_interleaved_schedule`.
+        Landing buffer is a full (v*M) grid — the same order of memory as
+        the autodiff residuals GPipe keeps anyway. Idle fill/drain ticks
+        compute a chunk on zeros and mask it (1/v of a stage — exactly the
+        bubble this schedule shrinks); embed and head stay owner-only and
+        once-per-microbatch, preserving the round-3 FLOP discipline.
+        """
+        cfg = self.cfg
+        M, mb, S = tokens_mbs.shape
+        P_, v = self.n_stages, self.virtual_chunks
+        Lc = self.layers_per_chunk
+        stage = lax.axis_index("pipe")
+        local_stack = params["stages"]  # (v, Lc, ...) per device
+        fwd = [(i, (i + 1) % P_) for i in range(P_)]
+        sched = _make_interleaved_schedule(M, P_, v)
+
+        embeds = lax.cond(
+            stage == 0,
+            lambda: self._embed_all(params["embed"], tokens_mbs),
+            lambda: jnp.zeros((M, mb, S, cfg.d_model), cfg.dtype),
+        )
+        x_zero = jnp.zeros((mb, S, cfg.d_model), cfg.dtype)
+        buf0 = jnp.zeros((v * M, mb, S, cfg.d_model), cfg.dtype)
+
+        def tick(carry, xs):
+            buf, x_in = carry
+            jr, mr, rf, rjr, rmr = xs
+            j = jnp.take(jr, stage)
+            m = jnp.take(mr, stage)
+
+            # land last tick's arrival in its (chunk, microbatch) slot
+            slot_r = jnp.take(rjr, stage) * M + jnp.take(rmr, stage)
+            cur = lax.dynamic_index_in_dim(buf, slot_r, 0, keepdims=False)
+            new = jnp.where(jnp.take(rf, stage).astype(bool), x_in, cur)
+            buf = lax.dynamic_update_index_in_dim(buf, new, slot_r, 0)
+
+            # this tick's op (idle devices compute on zeros and mask)
+            jc = jnp.clip(j, 0, v - 1)
+            mc = jnp.clip(m, 0, M - 1)
+            x_src = lax.dynamic_index_in_dim(buf, jc * M + mc, 0,
+                                             keepdims=False)
+            x_emb = lax.dynamic_index_in_dim(embeds, mc, 0, keepdims=False)
+            is_entry = (stage == 0) & (jc == 0)  # chunk-stage 0 injects
+            x = jnp.where(is_entry, x_emb, x_src)
+            chunk_params = jax.tree.map(
+                lambda p: lax.dynamic_index_in_dim(p, jc, 0, keepdims=False),
+                local_stack,
+            )
+            y = self._stage_apply(chunk_params, x)
+            x_out = jnp.where(j >= 0, y, x_zero)
+            nxt = cc.ppermute(x_out, "pipe", fwd)
+            return (buf, nxt), x_out
+
+        xs = tuple(
+            jnp.asarray(sched[k])
+            for k in ("jrow", "mbrow", "rflag", "rj", "rm")
+        )
+        (_, _), taps = lax.scan(tick, (buf0, x_zero), xs)
+
+        # microbatch m's final activations appear on device P-1 at the tick
+        # its last chunk-stage ran
+        tick_idx = jnp.asarray(
+            [sched["done"][P_ * v - 1][m] for m in range(M)], jnp.int32
+        )
+        finals = taps[tick_idx]  # (M, mb, S, d) — meaningful on stage P-1
+
+        loss_sum = lax.cond(
+            stage == P_ - 1,
+            lambda: self._head_loss_sum(params["head"], finals, tokens_mbs),
+            lambda: jnp.float32(0.0),
+        )
+        return loss_sum / M  # local; caller psums the VALUE (see above)
 
     # -- 1F1B schedule (manual VJP) -------------------------------------------
     def _loss_and_grads_1f1b(self, params, tokens_mbs):
@@ -515,6 +702,10 @@ class PipelinedLM:
             mbs = tokens.reshape(M, tokens.shape[0] // M, tokens.shape[1])
             if self.schedule == "1f1b":
                 local_loss, grads = self._loss_and_grads_1f1b(params, mbs)
+            elif self.virtual_chunks > 1:
+                local_loss, grads = jax.value_and_grad(
+                    self._pipeline_loss_interleaved
+                )(params, mbs)
             else:
                 local_loss, grads = jax.value_and_grad(self._pipeline_loss)(
                     params, mbs
