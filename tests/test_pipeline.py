@@ -260,7 +260,7 @@ def test_interleaved_pipeline_matches_unpipelined(n_pipe, v, M):
 
 
 @pytest.mark.parametrize("M,P,v", [(4, 2, 2), (8, 4, 2), (4, 4, 4),
-                                   (8, 4, 1), (8, 2, 4)])
+                                   (8, 4, 1), (8, 2, 4), (1, 2, 2)])
 def test_interleaved_schedule_invariants(M, P, v):
     from distributed_tensorflow_guide_tpu.parallel.pipeline import (
         _make_interleaved_schedule,
@@ -286,5 +286,9 @@ def test_interleaved_schedule_invariants(M, P, v):
     assert T >= M * v  # device 0 alone needs M*v ticks
     if v == 1:
         assert T == M + P - 1
+    elif M == 1:
+        # single microbatch: serial traversal of all D chunk-stages, no
+        # bubble to amortize — interleaving neither helps nor hurts
+        assert T / v == M + P - 1, (T, v, M, P)
     else:
         assert T / v < M + P - 1, (T, v, M, P)
