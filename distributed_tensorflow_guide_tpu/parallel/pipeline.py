@@ -453,9 +453,10 @@ class PipelinedLM:
         chunks per the static table from :func:`_make_interleaved_schedule`.
         Landing buffer is a full (v*M) grid — the same order of memory as
         the autodiff residuals GPipe keeps anyway. Idle fill/drain ticks
-        compute a chunk on zeros and mask it (1/v of a stage — exactly the
-        bubble this schedule shrinks); embed and head stay owner-only and
-        once-per-microbatch, preserving the round-3 FLOP discipline.
+        are FREE at runtime (``lax.cond`` executes one branch; note static
+        FLOP counters that model cond as max-of-branches still charge
+        them); embed and head stay owner-only and once-per-microbatch,
+        preserving the round-3 FLOP discipline.
         """
         cfg = self.cfg
         M, mb, S = tokens_mbs.shape
@@ -486,20 +487,27 @@ class PipelinedLM:
             new = jnp.where(jnp.take(rf, stage).astype(bool), x_in, cur)
             buf = lax.dynamic_update_index_in_dim(buf, new, slot_r, 0)
 
-            # this tick's op (idle devices compute on zeros and mask)
+            # this tick's op; lax.cond executes ONE branch, so idle
+            # fill/drain ticks cost no chunk FLOPs (same discipline as the
+            # 1F1B switch — collectives stay outside the cond)
             jc = jnp.clip(j, 0, v - 1)
             mc = jnp.clip(m, 0, M - 1)
-            x_src = lax.dynamic_index_in_dim(buf, jc * M + mc, 0,
-                                             keepdims=False)
-            x_emb = lax.dynamic_index_in_dim(embeds, mc, 0, keepdims=False)
-            is_entry = (stage == 0) & (jc == 0)  # chunk-stage 0 injects
-            x = jnp.where(is_entry, x_emb, x_src)
-            chunk_params = jax.tree.map(
-                lambda p: lax.dynamic_index_in_dim(p, jc, 0, keepdims=False),
-                local_stack,
-            )
-            y = self._stage_apply(chunk_params, x)
-            x_out = jnp.where(j >= 0, y, x_zero)
+
+            def run_chunk():
+                x_src = lax.dynamic_index_in_dim(buf, jc * M + mc, 0,
+                                                 keepdims=False)
+                x_emb = lax.dynamic_index_in_dim(embeds, mc, 0,
+                                                 keepdims=False)
+                is_entry = (stage == 0) & (jc == 0)  # chunk-stage 0 injects
+                x = jnp.where(is_entry, x_emb, x_src)
+                chunk_params = jax.tree.map(
+                    lambda p: lax.dynamic_index_in_dim(p, jc, 0,
+                                                       keepdims=False),
+                    local_stack,
+                )
+                return self._stage_apply(chunk_params, x)
+
+            x_out = lax.cond(j >= 0, run_chunk, lambda: x_zero)
             nxt = cc.ppermute(x_out, "pipe", fwd)
             return (buf, nxt), x_out
 
