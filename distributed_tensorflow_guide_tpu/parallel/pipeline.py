@@ -348,11 +348,25 @@ class PipelinedLM:
 
     # -- the schedule ---------------------------------------------------------
     def _stage_apply(self, stage_params, x):
-        """Run this stage's ``layers_per_stage`` blocks (scan over layers)."""
+        """Run this stage's layer blocks (scan over the stack's rows).
+
+        ``cfg.remat`` reaches the autodiff schedules here: the scan body is
+        checkpointed per block, so GPipe/interleaved backward recomputes
+        block internals from block boundaries instead of storing every
+        intermediate — the same memory contract 1F1B gets from its manual
+        per-stage recompute. The knob is deliberately NOT applied under
+        1F1B: its VJP already recomputes from the saved stage input, and
+        checkpointing on top would just re-run each block once more per
+        backward tick for no residual-memory gain. prevent_cse=False as in
+        models/transformer.py — the body lives inside lax.scan, where the
+        CSE barriers are unnecessary.
+        """
 
         def body(h, layer_params):
             return self.block.apply({"params": layer_params}, h), None
 
+        if self.cfg.remat and self.schedule != "1f1b":
+            body = jax.checkpoint(body, prevent_cse=False)
         out, _ = lax.scan(body, x, stage_params)
         return out
 

@@ -312,3 +312,34 @@ def test_interleaved_flop_discipline():
         "device — non-owner head/embed are burning compute"
     )
     assert ratio > 0.4, ratio
+
+
+@pytest.mark.parametrize("schedule,n_pipe,v", [("gpipe", 4, 1),
+                                                ("gpipe", 2, 2),
+                                                ("1f1b", 4, 1)])
+def test_remat_parity_across_schedules(schedule, n_pipe, v):
+    """cfg.remat on any schedule is an execution-plan change (and a no-op
+    under 1F1B, which already recomputes): gradient parity with the
+    non-remat run must hold on every path."""
+    import dataclasses
+
+    mesh = build_mesh(MeshSpec(data=-1, pipe=n_pipe))
+    n_data = mesh.shape["data"]
+    tokens = _tokens(4 * 2 * n_data)
+
+    def one_step(remat):
+        cfg = dataclasses.replace(CFG, remat=remat)
+        pp = PipelinedLM(mesh, cfg, num_microbatches=4, schedule=schedule,
+                         virtual_chunks=v)
+        params = pp.init_params(jax.random.PRNGKey(0))
+        tx = optax.sgd(0.1)
+        opt_state = pp.init_opt_state(tx, params)
+        step = pp.make_train_step(tx, params, donate=False)
+        _, params2, m = step(opt_state, params, tokens)
+        return float(m["loss"]), jax.tree.map(np.asarray, params2)
+
+    l0, p0 = one_step(False)
+    l1, p1 = one_step(True)
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1), strict=True):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
