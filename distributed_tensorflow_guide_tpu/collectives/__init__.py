@@ -8,5 +8,7 @@ from distributed_tensorflow_guide_tpu.collectives.collectives import (  # noqa: 
     psum,
     reduce_scatter,
     ring_shift,
+    tp_allreduce,
+    tp_identity,
     trace_comm,
 )

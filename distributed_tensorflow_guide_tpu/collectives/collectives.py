@@ -28,6 +28,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import dataclasses
+import functools
 from collections import defaultdict
 from typing import Any
 
@@ -133,6 +134,66 @@ def ring_shift(x, axis: str, *, shift: int = 1):
     n = lax.axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return ppermute(x, axis, perm)
+
+
+# -- Megatron tensor-parallel conjugate operators (f / g) ---------------------
+#
+# Manual-SPMD tensor parallelism (TP inside shard_map, e.g. TP-sharded
+# pipeline stages) cannot use bare ``lax.psum`` around the row-parallel
+# matmuls: under ``shard_map`` with replication-checking off, the transpose
+# of psum is another psum, so autodiff would multiply cotangents by the TP
+# degree. Megatron (Shoeybi et al. 2019, §3) defines the conjugate pair
+#   f: identity forward, all-reduce backward   (at the parallel block input)
+#   g: all-reduce forward, identity backward   (after the row-parallel matmul)
+# which is exactly the VJP structure pinned here with ``jax.custom_vjp``.
+# With f at each sub-layer input and g at each sub-layer output, parameter
+# gradients of the sharded weights stay local (matching their shard specs)
+# and every replicated tensor's gradient (LayerNorm, embeddings, residual
+# stream) arrives correctly summed over the TP shards.
+
+
+def tp_allreduce(x, axis: str):
+    """Megatron's ``g``: psum forward, identity backward."""
+    _record("psum", axis, x)  # wire traffic is the forward psum
+    return _tp_g(x, axis)
+
+
+def tp_identity(x, axis: str):
+    """Megatron's ``f``: identity forward, psum backward."""
+    _record("psum_bwd", axis, x)  # wire traffic happens in the backward pass
+    return _tp_f(x, axis)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_g(x, axis):
+    return lax.psum(x, axis)
+
+
+def _tp_g_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _tp_g_bwd(axis, _, ct):
+    return (ct,)
+
+
+_tp_g.defvjp(_tp_g_fwd, _tp_g_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_f(x, axis):
+    return x
+
+
+def _tp_f_fwd(x, axis):
+    return x, None
+
+
+def _tp_f_bwd(axis, _, ct):
+    return (lax.psum(ct, axis),)
+
+
+_tp_f.defvjp(_tp_f_fwd, _tp_f_bwd)
 
 
 def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int):

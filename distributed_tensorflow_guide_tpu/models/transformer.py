@@ -27,6 +27,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from distributed_tensorflow_guide_tpu.collectives import (
+    tp_allreduce,
+    tp_identity,
+)
+
 Dtype = Any
 
 
@@ -55,6 +60,16 @@ class TransformerConfig:
     #            (XLA's fused softmax beats the kernel-dispatch overhead) and
     #            CANNOT COMPILE at >= 1024 under remat, where flash runs.
     attn_impl: str = "auto"
+    # Manual-SPMD tensor parallelism (TP inside shard_map, e.g. TP-sharded
+    # pipeline stages): set ``tp_axis`` to the mesh axis name and build the
+    # module with LOCAL head/ff counts (num_heads / tp, d_ff / tp, plus
+    # ``override_head_dim`` to keep head_dim at its global value). The
+    # modules then bracket each sub-layer with Megatron's f/g conjugate
+    # operators (collectives.tp_identity / tp_allreduce) so both values and
+    # gradients are exact. Leave None under pjit/GSPMD (TensorParallel
+    # strategy), where XLA inserts the collectives itself.
+    tp_axis: str | None = None
+    override_head_dim: int | None = None
 
     def __post_init__(self):
         if self.attn_impl not in ("auto", "dense", "flash"):
@@ -78,8 +93,27 @@ class TransformerConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.override_head_dim is not None:
+            return self.override_head_dim
         assert self.d_model % self.num_heads == 0
         return self.d_model // self.num_heads
+
+    def tp_local(self, tp: int, axis: str = "model") -> "TransformerConfig":
+        """The per-shard view of this config under ``tp``-way manual tensor
+        parallelism: local head/ff counts, global head_dim pinned, f/g
+        operators enabled on ``axis``."""
+        if self.num_heads % tp or self.d_ff % tp:
+            raise ValueError(
+                f"num_heads={self.num_heads} and d_ff={self.d_ff} must both "
+                f"divide by tp={tp}"
+            )
+        return dataclasses.replace(
+            self,
+            num_heads=self.num_heads // tp,
+            d_ff=self.d_ff // tp,
+            override_head_dim=self.head_dim,
+            tp_axis=axis,
+        )
 
 
 def gpt2_124m(**kw) -> TransformerConfig:
@@ -114,6 +148,8 @@ class MultiHeadAttention(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:  # (B, S, D)
         cfg = self.cfg
         h, hd = cfg.num_heads, cfg.head_dim
+        if cfg.tp_axis:  # Megatron f: identity fwd, psum bwd (see tp_axis doc)
+            x = tp_identity(x, cfg.tp_axis)
         qkv = nn.DenseGeneral(
             (3, h, hd),
             axis=-1,
@@ -155,6 +191,8 @@ class MultiHeadAttention(nn.Module):
             use_bias=False,
             name="proj",
         )(out)
+        if cfg.tp_axis:  # Megatron g: psum fwd (row-parallel proj), id bwd
+            out = tp_allreduce(out, cfg.tp_axis)
         return out
 
 
@@ -164,6 +202,8 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
+        if cfg.tp_axis:  # Megatron f
+            x = tp_identity(x, cfg.tp_axis)
         y = nn.Dense(
             cfg.d_ff,
             dtype=cfg.dtype,
@@ -182,6 +222,8 @@ class MLP(nn.Module):
             use_bias=False,
             name="down",
         )(y)
+        if cfg.tp_axis:  # Megatron g (row-parallel down-projection)
+            y = tp_allreduce(y, cfg.tp_axis)
         return y
 
 

@@ -156,6 +156,190 @@ def _make_1f1b_schedule(M: int, P: int):
             "R": R, "T": T}
 
 
+def _make_interleaved_1f1b_schedule(M: int, P: int, v: int):
+    """Static interleaved-1F1B schedule (Megatron-LM's combined schedule:
+    Narayanan et al. 2021 §2.2) — BOTH the 1F1B O(P) in-flight memory cap
+    and interleaving's ~v-fold bubble shrink in one table.
+
+    D = v*P chunk-stages; chunk-stage k = j*P + s runs on device s as local
+    chunk row j, so every k -> k+1 hand-off is one forward ``ppermute`` hop
+    and every cotangent hand-off one backward hop. Each device executes a
+    FIXED op sequence (warmup forwards, then 1F/1B pairs, then cooldown
+    backwards), stalling when an op's input has not yet arrived — exactly
+    how Megatron's executor behaves, here pre-simulated into per-tick
+    tables so the SPMD loop stays a static ``lax.scan``.
+
+    Per-device op order (requires ``M % P == 0``, as in Megatron):
+      - forwards are chunk-grouped: chunk 0 takes microbatches 0..P-1, then
+        chunk 1 takes 0..P-1, ... chunk v-1, then chunk 0 takes P..2P-1, …
+      - backwards mirror it with chunks reversed (v-1 first).
+      - warmup length W(s) = min(v*M, 2*(P-s-1) + (v-1)*P).
+
+    Returns tables (T, P): ``op`` (0 idle / 1 fwd / 2 bwd), ``jr`` (local
+    chunk row), ``mb``; arrival tables ``sa``/``saj``/``sam`` (an
+    activation sent by device s-1 at t-1 lands this tick, destined for
+    local chunk row ``saj``, microbatch ``sam``) and ``sc``/``scj``/``scm``
+    for cotangents; ring depth ``R`` (slot = (j*M + m) % R, interval-
+    checked); ``f_done``/``b_done`` tick stamps; ``T``.
+    """
+    import numpy as np
+
+    if P < 2 or v < 2:
+        raise ValueError(f"interleaved 1F1B needs P >= 2, v >= 2 (got {P}, {v})")
+    if M % P:
+        raise ValueError(
+            f"interleaved 1F1B requires num_microbatches % pipe == 0 "
+            f"(got M={M}, P={P}) — the chunk-grouped issue order rides "
+            "groups of P microbatches"
+        )
+    D = v * P
+    TF = v * M  # forward (and backward) ops per device
+
+    def f_index(i):
+        group, pos = divmod(i, P)
+        rnd, j = divmod(group, v)
+        return j, rnd * P + pos
+
+    def b_index(i):
+        group, pos = divmod(i, P)
+        rnd, jr = divmod(group, v)
+        return v - 1 - jr, rnd * P + pos
+
+    seqs: list[list[tuple[int, int, int]]] = []
+    for s in range(P):
+        W = min(TF, 2 * (P - s - 1) + (v - 1) * P)
+        ops: list[tuple[int, int, int]] = []
+        nf = nb = 0
+        while nf < W:
+            ops.append((1, *f_index(nf)))
+            nf += 1
+        while nf < TF:
+            ops.append((1, *f_index(nf)))
+            nf += 1
+            ops.append((2, *b_index(nb)))
+            nb += 1
+        while nb < TF:
+            ops.append((2, *b_index(nb)))
+            nb += 1
+        seqs.append(ops)
+
+    ptr = [0] * P
+    f_done = [[-1] * M for _ in range(D)]
+    b_done = [[-1] * M for _ in range(D)]
+    rows: list[list[tuple[int, int, int]]] = []
+    t = 0
+    while any(ptr[s] < 2 * TF for s in range(P)):
+        row: list[tuple[int, int, int]] = []
+        for s in range(P):
+            if ptr[s] >= 2 * TF:
+                row.append((0, 0, 0))
+                continue
+            op, j, m = seqs[s][ptr[s]]
+            k = j * P + s
+            if op == 1:
+                ready = k == 0 or 0 <= f_done[k - 1][m] < t
+            else:
+                ready = 0 <= f_done[k][m] < t and (
+                    k == D - 1 or 0 <= b_done[k + 1][m] < t
+                )
+            row.append((op, j, m) if ready else (0, 0, 0))
+        progress = False
+        for s, (op, j, m) in enumerate(row):
+            if op == 1:
+                f_done[j * P + s][m] = t
+                ptr[s] += 1
+                progress = True
+            elif op == 2:
+                b_done[j * P + s][m] = t
+                ptr[s] += 1
+                progress = True
+        if not progress:  # pragma: no cover - the fixed order is deadlock-free
+            raise RuntimeError(
+                f"interleaved 1F1B schedule deadlocked at tick {t} "
+                f"(M={M}, P={P}, v={v})"
+            )
+        rows.append(row)
+        t += 1
+        if t > 8 * (TF + P) + 16:  # pragma: no cover - safety
+            raise RuntimeError("interleaved 1F1B schedule did not converge")
+    T = t
+
+    op = np.zeros((T, P), np.int32)
+    jr = np.zeros((T, P), np.int32)
+    mb = np.zeros((T, P), np.int32)
+    for tt, row in enumerate(rows):
+        for s, (o, j, m) in enumerate(row):
+            op[tt, s], jr[tt, s], mb[tt, s] = o, j, m
+
+    # Arrivals: what device s-1 forwarded at t-1 lands on s at t (destined
+    # for chunk-stage k+1, unless k was the tap D-1); what device s+1
+    # backwarded at t-1 lands on s as the cotangent for chunk-stage k-1.
+    sa = np.zeros((T, P), np.int32)
+    saj = np.zeros((T, P), np.int32)
+    sam = np.zeros((T, P), np.int32)
+    sc = np.zeros((T, P), np.int32)
+    scj = np.zeros((T, P), np.int32)
+    scm = np.zeros((T, P), np.int32)
+    for tt in range(1, T):
+        for s in range(P):
+            o, j, m = rows[tt - 1][(s - 1) % P]
+            if o == 1:
+                k = j * P + (s - 1) % P
+                if k + 1 < D:
+                    assert (k + 1) % P == s
+                    sa[tt, s], saj[tt, s], sam[tt, s] = 1, (k + 1) // P, m
+            o, j, m = rows[tt - 1][(s + 1) % P]
+            if o == 2:
+                k = j * P + (s + 1) % P
+                if k - 1 >= 0:
+                    assert (k - 1) % P == s
+                    sc[tt, s], scj[tt, s], scm[tt, s] = 1, (k - 1) // P, m
+
+    def slots_ok(R: int) -> bool:
+        """No (j*M+m) % R slot overwritten before its consumer runs."""
+        for s in range(P):
+            intervals: dict[int, list[tuple[int, int]]] = {}
+
+            def add(slot, t0, t1):
+                intervals.setdefault(slot, []).append((t0, t1))
+
+            for j in range(v):
+                k = j * P + s
+                for m in range(M):
+                    u = (j * M + m) % R
+                    if k > 0:
+                        add(u, f_done[k - 1][m] + 1, f_done[k][m])
+                    add(u + R, f_done[k][m], b_done[k][m])  # resid
+                    if k < D - 1:
+                        add(u + 2 * R, b_done[k + 1][m] + 1, b_done[k][m])
+            for spans in intervals.values():
+                spans.sort()
+                for (a0, a1), (b0, _b1) in zip(spans, spans[1:]):
+                    if b0 <= a1:
+                        return False
+        return True
+
+    max_inflight = 1
+    for s in range(P):
+        events = []
+        for j in range(v):
+            k = j * P + s
+            for m in range(M):
+                events.append((f_done[k][m], 1))
+                events.append((b_done[k][m], -1))
+        cur = 0
+        for _, d in sorted(events):
+            cur += d
+            max_inflight = max(max_inflight, cur)
+    R = max_inflight
+    while not slots_ok(R):
+        R += 1
+    return {"op": op, "jr": jr, "mb": mb, "sa": sa, "saj": saj, "sam": sam,
+            "sc": sc, "scj": scj, "scm": scm, "R": R, "T": T,
+            "f_done": f_done, "b_done": b_done,
+            "max_inflight": max_inflight}
+
+
 def _make_interleaved_schedule(M: int, P: int, v: int):
     """Forward schedule for interleaved GPipe (Megatron virtual stages):
     D = v*P chunk-stages laid round-robin on P devices (chunk-stage k lives
@@ -265,16 +449,27 @@ class PipelinedLM:
         self.n_stages = sizes["pipe"]
         self.n_data = sizes["data"]
         self.num_microbatches = num_microbatches
-        # Interleaved GPipe (Megatron virtual stages): each device holds
+        # Interleaved schedules (Megatron virtual stages): each device holds
         # ``virtual_chunks`` non-contiguous layer chunks; chunk-stage
         # k = j*P + s lives on device s as local row j. Fill/drain slots
-        # cost a 1/v stage, shrinking the bubble ~v-fold
-        # (_make_interleaved_schedule). v > 1 is a gpipe-schedule feature
-        # (autodiff produces the reversed drain); 1F1B keeps v = 1.
+        # cost a 1/v stage, shrinking the bubble ~v-fold. Under gpipe the
+        # autodiff produces the reversed drain (_make_interleaved_schedule);
+        # under 1f1b the combined Megatron schedule
+        # (_make_interleaved_1f1b_schedule) ALSO keeps the O(P) in-flight
+        # memory cap — the production pairing.
         if virtual_chunks < 1:
             raise ValueError(f"virtual_chunks must be >= 1, got {virtual_chunks}")
-        if virtual_chunks > 1 and schedule != "gpipe":
-            raise ValueError("virtual_chunks > 1 requires schedule='gpipe'")
+        if virtual_chunks > 1 and schedule == "1f1b":
+            if sizes["pipe"] < 2:
+                raise ValueError(
+                    "interleaved 1F1B needs pipe >= 2 (got "
+                    f"{sizes['pipe']}); gpipe handles the degenerate case"
+                )
+            if num_microbatches % sizes["pipe"]:
+                raise ValueError(
+                    f"interleaved 1F1B requires num_microbatches divisible "
+                    f"by pipe ({num_microbatches} % {sizes['pipe']} != 0)"
+                )
         self.virtual_chunks = virtual_chunks
         n_chunk_stages = self.n_stages * virtual_chunks
         if cfg.num_layers % n_chunk_stages:
@@ -288,6 +483,30 @@ class PipelinedLM:
         self.embedder = _Embedder(cfg)
         self.head = _Head(cfg)
         self.block = Block(cfg)
+        # 3D parallelism (dp x tp x pp): when the mesh's ``model`` axis is
+        # >1, each pipeline stage's blocks are Megatron-TP-sharded over it —
+        # qkv/up kernels column-parallel (heads / d_ff dims), proj/down
+        # row-parallel, with the f/g conjugate operators inside the block
+        # (models/transformer.py ``tp_axis``) keeping values AND gradients
+        # exact inside this strategy's manual-SPMD shard_map. Params are
+        # initialized at global shapes and sharded by per-leaf specs
+        # (:meth:`param_specs`); each device applies a LOCAL-config block on
+        # its (heads/tp, d_ff/tp) shard. Embed/head stay replicated over
+        # ``model`` (vocab-parallel loss is a further extension).
+        self.tp = sizes["model"]
+        if self.tp > 1:
+            self.block_apply = Block(cfg.tp_local(self.tp, axis="model"))
+            abs_block = jax.eval_shape(
+                self.block.init,
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, cfg.max_len, cfg.d_model), cfg.dtype),
+            )["params"]
+            self._stage_specs_tp = jax.tree_util.tree_map_with_path(
+                lambda path, _: self._stage_leaf_spec(path),
+                nn.meta.unbox(abs_block),
+            )
+        else:
+            self.block_apply = self.block
 
     # -- params ---------------------------------------------------------------
     def init_params(self, rng) -> dict:
@@ -328,8 +547,45 @@ class PipelinedLM:
         params = {"embed": emb, "stages": stacked, "head": head}
         return jax.device_put(params, self.param_shardings())
 
+    @staticmethod
+    def _stage_leaf_spec(path) -> P:
+        """Megatron placement for one stacked stage leaf (dims: row, layer,
+        *param). Column-parallel kernels shard their output dim (heads /
+        d_ff), row-parallel their input dim; everything else replicates
+        over ``model``."""
+        names = tuple(
+            k.key for k in path if isinstance(k, jax.tree_util.DictKey)
+        )
+        table = {
+            ("attn", "qkv", "kernel"): P("pipe", None, None, None, "model"),
+            ("attn", "proj", "kernel"): P("pipe", None, "model"),
+            ("mlp", "up", "kernel"): P("pipe", None, None, "model"),
+            ("mlp", "up", "bias"): P("pipe", None, "model"),
+            ("mlp", "down", "kernel"): P("pipe", None, "model"),
+        }
+        return table.get(names[-3:], P("pipe"))
+
+    def layout_metadata(self) -> dict:
+        """Layout identity for checkpoints (``Checkpointer.save(layout=)``).
+
+        The interleaved stacking permutes layer order inside
+        ``params['stages']`` — a (P=2, v=2) tree is shape-identical to a
+        (P=4, v=1) tree, so orbax would silently restore one into the
+        other with the wrong layer order. This dict pins the layout so
+        restore can refuse the mismatch."""
+        return {
+            "format": "pipelined_lm_stages",
+            "n_stages": self.n_stages,
+            "virtual_chunks": self.virtual_chunks,
+            "layers_per_chunk": self.layers_per_chunk,
+            "tp": self.tp,
+        }
+
     def param_specs(self) -> dict:
-        """Prefix spec tree: stage stack sharded over pipe, rest replicated."""
+        """Spec tree: stage stack sharded over pipe (and, when the mesh has
+        a ``model`` axis, Megatron-TP over it per leaf), rest replicated."""
+        if self.tp > 1:
+            return {"embed": P(), "stages": self._stage_specs_tp, "head": P()}
         return {"embed": P(), "stages": P("pipe"), "head": P()}
 
     def param_shardings(self):
@@ -340,11 +596,33 @@ class PipelinedLM:
         )
 
     def opt_state_specs(self, tx: optax.GradientTransformation, params):
-        """Specs for the optimizer state: moments inherit their param's spec
-        (matched by shape+dtype — stage stacks have a distinctive leading
-        n_stages dim), counts/scalars replicate."""
+        """Specs for the optimizer state: moment trees (optax state nodes
+        that mirror the param tree's structure) inherit the params' full
+        spec tree; everything else (counts, scalars) replicates.
+
+        Structural matching, not shape matching: under TP the per-leaf
+        stage specs differ BETWEEN same-shaped leaves (e.g. ``mlp/up/bias``
+        is model-sharded while an ``ln`` scale of the same shape is
+        replicated — they collide whenever d_ff == d_model), which is
+        exactly the case ``assign_by_shape``'s docstring disclaims."""
         full = expand_prefix(self.param_specs(), params)
-        return assign_by_shape(params, full, jax.eval_shape(tx.init, params), P())
+        treedef_p = jax.tree.structure(params)
+
+        def is_param_shaped(node) -> bool:
+            try:
+                return jax.tree.structure(node) == treedef_p
+            except Exception:
+                return False
+
+        def specs_for(node):
+            if is_param_shaped(node):
+                return full
+            return jax.tree.map(lambda _: P(), node)
+
+        return jax.tree.map(
+            specs_for, jax.eval_shape(tx.init, params),
+            is_leaf=is_param_shaped,
+        )
 
     # -- the schedule ---------------------------------------------------------
     def _stage_apply(self, stage_params, x):
@@ -363,7 +641,7 @@ class PipelinedLM:
         """
 
         def body(h, layer_params):
-            return self.block.apply({"params": layer_params}, h), None
+            return self.block_apply.apply({"params": layer_params}, h), None
 
         if self.cfg.remat and self.schedule != "1f1b":
             body = jax.checkpoint(body, prevent_cse=False)
@@ -711,6 +989,183 @@ class PipelinedLM:
         }
         return loss_acc, grads
 
+    # -- interleaved 1F1B (manual VJP, v chunks per device) --------------------
+    def _loss_and_grads_1f1b_interleaved(self, params, tokens_mbs):
+        """Per-device interleaved-1F1B: Megatron's combined schedule
+        (virtual chunks × 1F1B) as one static-table scan — the O(P)
+        in-flight cap of :meth:`_loss_and_grads_1f1b` AND the ~v-fold
+        bubble shrink of :meth:`_pipeline_loss_interleaved` together.
+
+        Differences from the v=1 tick loop: the op dispatch carries a local
+        chunk row ``j`` (chunk-stage k = j*P + s), chunk params are gathered
+        from the (v, Lc, ...) local stack per tick, ring-buffer slots are
+        keyed by (j*M + m) % R, and the embed/head ownership predicates
+        sharpen from ``stage == 0`` / ``stage == P-1`` to chunk-stage 0 /
+        chunk-stage D-1 (i.e. also require j == 0 / j == v-1). Collectives
+        stay OUTSIDE the switch: one activation ppermute forward and one
+        cotangent ppermute backward per tick, zeros when idle.
+        """
+        cfg = self.cfg
+        M, mb, S = tokens_mbs.shape
+        P_, v = self.n_stages, self.virtual_chunks
+        stage = lax.axis_index("pipe")
+        local_stack = params["stages"]  # (v, Lc, ...) per device
+        fwd_perm = [(i, (i + 1) % P_) for i in range(P_)]
+        bwd_perm = [(i, (i - 1) % P_) for i in range(P_)]
+        sched = _make_interleaved_1f1b_schedule(M, P_, v)
+        R = sched["R"]
+
+        embeds = lax.cond(
+            stage == 0,
+            lambda: self._embed_all(params["embed"], tokens_mbs),
+            lambda: jnp.zeros((M, mb, S, cfg.d_model), cfg.dtype),
+        )
+
+        def chunk_fn(cp, x):
+            return self._stage_apply(cp, x)
+
+        def last_chunk_loss(cp, hp, x, toks):
+            out = self._stage_apply(cp, x)
+            return self._mb_loss(hp, out, toks) / M
+
+        f32 = jnp.float32
+        zero_g = {
+            "embed": jax.tree.map(lambda p: jnp.zeros(p.shape, f32),
+                                  params["embed"]),
+            "stages": jax.tree.map(lambda p: jnp.zeros(p.shape, f32),
+                                   local_stack),
+            "head": jax.tree.map(lambda p: jnp.zeros(p.shape, f32),
+                                 params["head"]),
+        }
+        buf = jnp.zeros((R, mb, S, cfg.d_model), cfg.dtype)
+        x_zero = jnp.zeros((mb, S, cfg.d_model), cfg.dtype)
+
+        def tick(carry, xs):
+            act_buf, cot_buf, resid_buf, act_in, cot_in, g_acc, loss_acc = carry
+            (op_row, jr_row, mb_row, sa_row, saj_row, sam_row,
+             sc_row, scj_row, scm_row) = xs
+            op = jnp.take(op_row, stage)
+            j = jnp.take(jr_row, stage)
+            m = jnp.take(mb_row, stage)
+
+            # 1) land last tick's arrivals in their (chunk, microbatch) slots
+            def land(buf_, val, flag, jrow, mrow):
+                slot = (jnp.take(jrow, stage) * M + jnp.take(mrow, stage)) % R
+                cur = lax.dynamic_index_in_dim(buf_, slot, 0, keepdims=False)
+                new = jnp.where(flag.astype(bool), val, cur)
+                return lax.dynamic_update_index_in_dim(buf_, new, slot, 0)
+
+            act_buf = land(act_buf, act_in, jnp.take(sa_row, stage),
+                           saj_row, sam_row)
+            cot_buf = land(cot_buf, cot_in, jnp.take(sc_row, stage),
+                           scj_row, scm_row)
+
+            slot = (j * M + m) % R
+            is_first = (stage == 0) & (j == 0)        # chunk-stage 0
+            is_last = (stage == P_ - 1) & (j == v - 1)  # chunk-stage D-1
+
+            # The chunk-params gather and token slice live INSIDE the switch
+            # branches (mirroring run_chunk in the gpipe-interleaved path):
+            # lax.cond/switch executes one branch, so idle fill/drain ticks
+            # pay neither the chunk-stack copy nor anything else.
+            def gather_chunk():
+                return jax.tree.map(
+                    lambda p: lax.dynamic_index_in_dim(p, j, 0,
+                                                       keepdims=False),
+                    local_stack,
+                )
+
+            # 2) this tick's op
+            def do_idle(resid_buf, g_acc, loss_acc):
+                return resid_buf, g_acc, loss_acc, x_zero, x_zero
+
+            def do_fwd(resid_buf, g_acc, loss_acc):
+                chunk_params = gather_chunk()
+                x_prev = lax.dynamic_index_in_dim(act_buf, slot, 0,
+                                                  keepdims=False)
+                x_emb = lax.dynamic_index_in_dim(embeds, m, axis=0,
+                                                 keepdims=False)
+                x_in = jnp.where(is_first, x_emb, x_prev)
+                resid_buf = lax.dynamic_update_index_in_dim(
+                    resid_buf, x_in, slot, 0
+                )
+                x_out = chunk_fn(chunk_params, x_in)
+                return resid_buf, g_acc, loss_acc, x_out, x_zero
+
+            def do_bwd(resid_buf, g_acc, loss_acc):
+                chunk_params = gather_chunk()
+                toks = lax.dynamic_index_in_dim(tokens_mbs, m, axis=0,
+                                                keepdims=False)
+                x_in = lax.dynamic_index_in_dim(resid_buf, slot, 0,
+                                                keepdims=False)
+
+                def last_branch():
+                    loss_m, vjp = jax.vjp(
+                        lambda cp, hp, x: last_chunk_loss(cp, hp, x, toks),
+                        chunk_params, params["head"], x_in,
+                    )
+                    d_cp, d_hp, dx = vjp(f32(1.0))
+                    return loss_m, d_cp, d_hp, dx
+
+                def mid_branch():
+                    g_out = lax.dynamic_index_in_dim(cot_buf, slot, 0,
+                                                     keepdims=False)
+                    _, vjp = jax.vjp(chunk_fn, chunk_params, x_in)
+                    d_cp, dx = vjp(g_out)
+                    return f32(0.0), d_cp, zero_g["head"], dx
+
+                loss_m, d_cp, d_hp, dx = lax.cond(
+                    is_last, last_branch, mid_branch
+                )
+
+                def embed_branch():
+                    _, evjp = jax.vjp(
+                        lambda ep: self.embedder.apply(
+                            {"params": ep}, toks
+                        ).astype(cfg.dtype),
+                        params["embed"],
+                    )
+                    (d_emb,) = evjp(dx)
+                    return jax.tree.map(lambda g: g.astype(f32), d_emb)
+
+                d_emb = lax.cond(
+                    is_first, embed_branch, lambda: zero_g["embed"]
+                )
+
+                def acc_chunk(a, g):
+                    cur = lax.dynamic_index_in_dim(a, j, 0, keepdims=False)
+                    return lax.dynamic_update_index_in_dim(
+                        a, cur + g.astype(f32), j, 0
+                    )
+
+                g_acc = {
+                    "embed": jax.tree.map(jnp.add, g_acc["embed"], d_emb),
+                    "stages": jax.tree.map(acc_chunk, g_acc["stages"], d_cp),
+                    "head": jax.tree.map(
+                        lambda a, g: a + g.astype(f32), g_acc["head"], d_hp
+                    ),
+                }
+                return resid_buf, g_acc, loss_acc + loss_m, x_zero, dx
+
+            resid_buf, g_acc, loss_acc, send_act, send_cot = lax.switch(
+                op, [do_idle, do_fwd, do_bwd], resid_buf, g_acc, loss_acc
+            )
+
+            # 3) unconditional neighbor exchange (zeros when idle)
+            act_in = cc.ppermute(send_act, "pipe", fwd_perm)
+            cot_in = cc.ppermute(send_cot, "pipe", bwd_perm)
+            return (act_buf, cot_buf, resid_buf, act_in, cot_in, g_acc,
+                    loss_acc), None
+
+        xs = tuple(
+            jnp.asarray(sched[k]) for k in ("op", "jr", "mb", "sa", "saj",
+                                            "sam", "sc", "scj", "scm")
+        )
+        (_, _, _, _, _, g_acc, loss_acc), _ = lax.scan(
+            tick, (buf, buf, buf, x_zero, x_zero, zero_g, f32(0.0)), xs
+        )
+        return loss_acc, g_acc
+
     # -- compiled step --------------------------------------------------------
     def make_train_step(self, tx: optax.GradientTransformation, params,
                         *, donate: bool = True):
@@ -722,7 +1177,11 @@ class PipelinedLM:
 
         def sm_step(opt_state, params, tokens):
             mbs = tokens.reshape(M, tokens.shape[0] // M, tokens.shape[1])
-            if self.schedule == "1f1b":
+            if self.schedule == "1f1b" and self.virtual_chunks > 1:
+                local_loss, grads = self._loss_and_grads_1f1b_interleaved(
+                    params, mbs
+                )
+            elif self.schedule == "1f1b":
                 local_loss, grads = self._loss_and_grads_1f1b(params, mbs)
             elif self.virtual_chunks > 1:
                 local_loss, grads = jax.value_and_grad(

@@ -216,18 +216,26 @@ def test_stage_params_actually_sharded():
     assert leaf.addressable_shards[0].data.shape[0] == 1  # one stage per device
 
 
-@pytest.mark.parametrize("n_pipe,v,M", [(2, 2, 4), (4, 2, 8), (2, 4, 4)])
-def test_interleaved_pipeline_matches_unpipelined(n_pipe, v, M):
-    """Interleaved GPipe (virtual chunks) is an execution schedule: loss and
-    gradients must equal the unpipelined oracle's, like every other
-    schedule — at several (stages, chunks, microbatches) shapes."""
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("n_pipe,v,M,tp", [(2, 2, 4, 1), (4, 2, 8, 1),
+                                           (2, 4, 4, 1), (2, 2, 4, 2)])
+def test_interleaved_pipeline_matches_unpipelined(n_pipe, v, M, tp, schedule):
+    """Interleaved pipelining (virtual chunks) is an execution schedule:
+    loss and gradients must equal the unpipelined oracle's, like every
+    other schedule — at several (stages, chunks, microbatches) shapes,
+    under BOTH the autodiff gpipe drain and the manual-VJP combined
+    interleaved-1F1B (Megatron production) schedule. The tp=2 case is the
+    full 3D program: data(2) x pipe(2) x model(2) with v=2 virtual chunks —
+    TP-sharded stages inside an interleaved pipeline under data
+    parallelism."""
     cfg = TransformerConfig(
         vocab_size=64, num_layers=8, num_heads=2, d_model=32, d_ff=64,
         max_len=16, causal=True, dtype=jnp.float32,
     )
-    mesh = build_mesh(MeshSpec(data=-1, pipe=n_pipe))
+    mesh = build_mesh(MeshSpec(data=-1, pipe=n_pipe, model=tp))
     n_data = mesh.shape["data"]
-    pp = PipelinedLM(mesh, cfg, num_microbatches=M, virtual_chunks=v)
+    pp = PipelinedLM(mesh, cfg, num_microbatches=M, schedule=schedule,
+                     virtual_chunks=v)
     params = pp.init_params(jax.random.PRNGKey(0))
     tx = optax.sgd(0.1)
     opt_state = pp.init_opt_state(tx, params)
@@ -290,6 +298,54 @@ def test_interleaved_schedule_invariants(M, P, v):
         assert T / v == M + P - 1, (T, v, M, P)
     else:
         assert T / v < M + P - 1, (T, v, M, P)
+
+
+@pytest.mark.parametrize("M,P,v", [(4, 2, 2), (8, 4, 2), (8, 2, 4),
+                                   (16, 4, 2), (32, 4, 2)])
+def test_interleaved_1f1b_schedule_invariants(M, P, v):
+    """The combined schedule must deliver BOTH contracts at once:
+    dependency-correct dataflow, an in-flight activation cap that depends
+    on (P, v) but NOT on M (the 1F1B memory contract), and a bubble no
+    worse than plain 1F1B's (P-1)/(M+P-1) (the interleaving contract)."""
+    from distributed_tensorflow_guide_tpu.parallel.pipeline import (
+        _make_interleaved_1f1b_schedule,
+    )
+
+    s = _make_interleaved_1f1b_schedule(M, P, v)
+    D = v * P
+    f, b = s["f_done"], s["b_done"]
+    for k in range(D):
+        for m in range(M):
+            assert f[k][m] >= 0 and b[k][m] > f[k][m]
+            if k:
+                # hand-off is one ppermute tick: strict ordering both ways
+                assert f[k][m] > f[k - 1][m]
+                assert b[k - 1][m] > b[k][m]
+    # one op per device per tick is structural (tables are (T, P))
+    # memory contract: warmup cap 2(P-1) + (v-1)P + 1, independent of M
+    cap = 2 * (P - 1) + (v - 1) * P + 1
+    assert s["max_inflight"] <= cap, (s["max_inflight"], cap)
+    assert s["R"] < 2 * v * M or v * M <= cap  # ring stays well under full
+    # bubble contract: an interleaved tick costs a 1/v stage, so the
+    # equivalent full-stage time is T/v; it must beat plain 1F1B's total
+    # (both schedules' tables model fwd and bwd ticks as equal cost)
+    from distributed_tensorflow_guide_tpu.parallel.pipeline import (
+        _make_1f1b_schedule,
+    )
+
+    T_plain = _make_1f1b_schedule(M, P)["T"]
+    assert s["T"] / v < T_plain, (s["T"], v, T_plain, M, P)
+
+
+def test_interleaved_1f1b_requires_divisible_microbatches():
+    mesh = build_mesh(MeshSpec(data=1, pipe=4, model=2))
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=8, num_heads=2, d_model=32, d_ff=64,
+        max_len=16, causal=True, dtype=jnp.float32,
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        PipelinedLM(mesh, cfg, num_microbatches=6, schedule="1f1b",
+                    virtual_chunks=2)
 
 
 def test_interleaved_flop_discipline():
