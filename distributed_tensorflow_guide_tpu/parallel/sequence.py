@@ -109,17 +109,31 @@ def _ring_xla(q, k, v, *, axis: str, causal: bool):
 # then masks them (~2x FLOP waste at large rings, round-2 verdict weak 4).
 
 
+def _pad_lane(x, d, dp):
+    """Pad head_dim to the kernel lane width — LOCALLY, at the kernel
+    boundary. The ring deliberately rotates UNPADDED tensors: at d=64 on
+    the 128-lane kernel, rotating padded tensors would double every hop's
+    ICI bytes (measured by bench_sp_comm — 2x wire for a VPU-cheap pad),
+    so the pad is re-applied per visit instead of travelling."""
+    if dp == d:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, dp - d)))
+
+
 def _ring_steps_fwd(q, k, v, axis, causal, scale):
-    """Ring forward in kernel layout (B, H, S_loc, Dp) -> (out, lse)."""
+    """Ring forward in kernel layout (B, H, S_loc, D) -> (out, lse)."""
     n = lax.axis_size(axis)
     my = lax.axis_index(axis)
-    b, h, s, dp = q.shape
+    b, h, s, d = q.shape
+    dp = -(-d // F.LANE) * F.LANE
     fwd = [(i, (i + 1) % n) for i in range(n)]
     m, l, acc = F.carry_init(b, h, s, dp)
+    qp = _pad_lane(q, d, dp)  # local: pad once, never rotates
 
     def step(diag):
         def run(m, l, acc, k_cur, v_cur):
-            return F.flash_carry_step(q, k_cur, v_cur, m, l, acc,
+            return F.flash_carry_step(qp, _pad_lane(k_cur, d, dp),
+                                      _pad_lane(v_cur, d, dp), m, l, acc,
                                       scale=scale, diag=diag)
 
         return run
@@ -146,7 +160,7 @@ def _ring_steps_fwd(q, k, v, axis, causal, scale):
         body, (m, l, acc, k, v, my), None, length=n
     )
     out, lse = F.carry_finalize(m, l, acc)
-    return out.astype(q.dtype), lse
+    return out[..., :d].astype(q.dtype), lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -170,15 +184,21 @@ def _ring_flash_bwd_rule(axis, causal, scale, res, g):
     my = lax.axis_index(axis)
     fwd = [(i, (i + 1) % n) for i in range(n)]
     f32 = jnp.float32
+    d = q.shape[-1]
+    dp = -(-d // F.LANE) * F.LANE
     delta = jnp.sum(g.astype(f32) * out.astype(f32), axis=-1)  # (B,H,S)
+    qp = _pad_lane(q, d, dp)       # local: pad once; rotations stay unpadded
+    gp = _pad_lane(g, d, dp)
 
     def run(diag):
         def go(k_cur, v_cur):
             dq_s, dk_s, dv_s = F._bwd_call(
-                q, k_cur, v_cur, g, lse, delta, scale=scale, causal=diag,
+                qp, _pad_lane(k_cur, d, dp), _pad_lane(v_cur, d, dp),
+                gp, lse, delta, scale=scale, causal=diag,
                 blk_q=128, blk_k=128,
             )
-            return dq_s.astype(f32), dk_s.astype(f32), dv_s.astype(f32)
+            return (dq_s[..., :d].astype(f32), dk_s[..., :d].astype(f32),
+                    dv_s[..., :d].astype(f32))
 
         return go
 
@@ -217,21 +237,18 @@ _ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
 
 
 def _ring_flash_public(q, k, v, *, axis: str, causal: bool):
-    """Public layout (B, S_loc, H, D) -> same; pads head dim to the lane
-    width (zero columns are exact no-ops, as in flash_attention)."""
-    b, s, h, d = q.shape
+    """Public layout (B, S_loc, H, D) -> same. Head-dim lane padding
+    happens INSIDE the ring steps (``_pad_lane``) so the rotations move
+    unpadded tensors — see the wire-bytes rationale there."""
+    d = q.shape[-1]
     scale = 1.0 / (d ** 0.5)
-    dp = -(-d // F.LANE) * F.LANE
 
     def to_kernel(x):
-        x = jnp.transpose(x, (0, 2, 1, 3))
-        if dp != d:
-            x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, dp - d)))
-        return x
+        return jnp.transpose(x, (0, 2, 1, 3))
 
     out = _ring_flash(to_kernel(q), to_kernel(k), to_kernel(v), axis,
                       causal, scale)
-    return jnp.transpose(out, (0, 2, 1, 3))[..., :d]
+    return jnp.transpose(out, (0, 2, 1, 3))
 
 
 def ulysses_attention(q, k, v, *, axis: str = "context",

@@ -64,3 +64,32 @@ def test_ulysses_comm_sites(ctx_mesh):
     assert rec.calls["all_to_all[context]"] == 4
     assert rec.bytes["all_to_all[context]"] == 4 * t
     assert rec.calls.get("ppermute[context]", 0) == 0
+
+
+def test_ring_pallas_fwd_bwd_comm_sites(ctx_mesh):
+    """The backward accounting the round-3 table ignored, pinned: the
+    Pallas ring's hand-written backward rotates FOUR tensors per hop
+    (k, v, dk-partial, dv-partial) through the wrapper layer, so
+    grad-tracing records 2 forward-rule + 4 backward sites. Byte check is
+    double duty: at D=32 on the 128-lane kernel, each site must move the
+    UNPADDED shard (t bytes, not 4t) — rotating kernel-padded tensors
+    would quadruple the wire bytes at this head dim (the pad is applied
+    locally per visit instead; see sequence.py ``_pad_lane``)."""
+    x = jnp.zeros((B, S, H, D), jnp.float32)
+    sm = jax.shard_map(
+        functools.partial(ring_attention, causal=True, impl="pallas"),
+        mesh=ctx_mesh,
+        in_specs=(P(None, "context"),) * 3,
+        out_specs=P(None, "context"),
+        check_vma=False,
+    )
+
+    def loss(q, k, v):
+        return jnp.sum(sm(q, k, v).astype(jnp.float32))
+
+    with cc.trace_comm() as rec:
+        jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(x, x, x)
+    t = int(np.prod((B, S // 4, H, D))) * 4
+    assert rec.calls["ppermute[context]"] == 6, dict(rec.calls)
+    assert rec.bytes["ppermute[context]"] == 6 * t, (
+        rec.bytes["ppermute[context]"], t)
