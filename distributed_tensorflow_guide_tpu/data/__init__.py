@@ -6,6 +6,14 @@ from distributed_tensorflow_guide_tpu.data.native_loader import (  # noqa: F401
     open_record_loader,
     write_records,
 )
+from distributed_tensorflow_guide_tpu.data.importers import (  # noqa: F401
+    MNIST_FIELDS,
+    decode_mnist_batch,
+    import_idx_pair,
+    import_mnist,
+    read_idx,
+    write_idx,
+)
 from distributed_tensorflow_guide_tpu.data.synthetic import (  # noqa: F401
     SyntheticClassification,
     SyntheticCTR,

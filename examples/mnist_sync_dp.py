@@ -30,6 +30,11 @@ def main() -> None:
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument("--fake-devices", type=int, default=0,
                     help="force N virtual CPU devices (testing without a pod)")
+    ap.add_argument("--data", default=None, metavar="DIR",
+                    help="directory with the standard MNIST IDX files "
+                         "(train-images-idx3-ubyte[.gz], ...); imported once "
+                         "into the native record format and streamed by the "
+                         "C++ loader. Default: synthetic MNIST-shaped data.")
     args = ap.parse_args()
 
     if args.fake_devices:
@@ -84,13 +89,34 @@ def main() -> None:
     )
 
     step = dp.make_train_step(make_loss_fn(model))
-    data = (dp.shard_batch(b) for b in synthetic_mnist(args.global_batch))
+    if args.data:
+        # real MNIST: IDX -> record file (once), then the native mmap/
+        # shuffle/prefetch loader feeds training — the reference's
+        # read_data_sets + feed_dict path, TPU-track shape
+        from distributed_tensorflow_guide_tpu.data.importers import (
+            decode_mnist_batch,
+            import_mnist,
+        )
+        from distributed_tensorflow_guide_tpu.data.native_loader import (
+            open_record_loader,
+        )
+        from distributed_tensorflow_guide_tpu.data.importers import MNIST_FIELDS
 
-    hooks = [
-        StopAtStepHook(args.steps),
-        LoggingHook(args.log_every),
-        StepCounterHook(args.log_every, batch_size=args.global_batch, n_chips=n_dev),
-    ]
+        rec = import_mnist(args.data, Path(args.data) / "records")
+        loader = open_record_loader(rec, MNIST_FIELDS, args.global_batch)
+        print(f"native loader: {loader.num_records} records from {rec} "
+              f"({type(loader).__name__})")
+        data = (dp.shard_batch(decode_mnist_batch(b)) for b in loader)
+    else:
+        data = (dp.shard_batch(b) for b in synthetic_mnist(args.global_batch))
+
+    hooks = [StopAtStepHook(args.steps)]
+    if args.log_every:  # 0 = silent (smoke tests)
+        hooks += [
+            LoggingHook(args.log_every),
+            StepCounterHook(args.log_every, batch_size=args.global_batch,
+                            n_chips=n_dev),
+        ]
     start_step = 0
     if args.ckpt_dir:
         ckpt = Checkpointer(args.ckpt_dir)

@@ -1,0 +1,165 @@
+"""Real-dataset ingestion: IDX (MNIST's interchange format) → records.
+
+The reference's examples all start from `input_data.read_data_sets`, which
+parses IDX files. These tests pin the importer against byte-exact synthetic
+IDX fixtures (written by `write_idx`, the importer's own inverse, AND by an
+independent hand-rolled packer so the pair can't share a bug), then prove
+the imported records stream identically through the C++ and Python loaders.
+"""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_guide_tpu.data.importers import (
+    decode_mnist_batch,
+    import_idx_pair,
+    import_mnist,
+    read_idx,
+    write_idx,
+)
+from distributed_tensorflow_guide_tpu.data.native_loader import (
+    NativeRecordLoader,
+    PyRecordLoader,
+    load_native_lib,
+)
+
+
+def _pack_idx_by_hand(arr: np.ndarray, code: int) -> bytes:
+    """Independent IDX packer (big-endian, straight from the spec)."""
+    out = bytes([0, 0, code, arr.ndim])
+    out += struct.pack(f">{arr.ndim}I", *arr.shape)
+    return out + arr.astype(arr.dtype.newbyteorder(">")).tobytes()
+
+
+@pytest.mark.parametrize("dtype,code", [(np.uint8, 0x08), (np.int8, 0x09),
+                                        (np.int16, 0x0B), (np.int32, 0x0C),
+                                        (np.float32, 0x0D),
+                                        (np.float64, 0x0E)])
+def test_read_idx_all_dtypes_vs_hand_packed(tmp_path, dtype, code):
+    rng = np.random.RandomState(0)
+    arr = (rng.randn(5, 3, 4) * 50).astype(dtype)
+    p = tmp_path / "x.idx"
+    p.write_bytes(_pack_idx_by_hand(arr, code))
+    got = read_idx(p)
+    np.testing.assert_array_equal(got, arr)
+    assert got.dtype == np.dtype(dtype)
+
+
+def test_write_read_roundtrip_and_gzip(tmp_path):
+    rng = np.random.RandomState(1)
+    arr = rng.randint(0, 256, (7, 28, 28)).astype(np.uint8)
+    plain = tmp_path / "r.idx"
+    write_idx(plain, arr)
+    # write_idx must produce the same bytes as the independent packer
+    assert plain.read_bytes() == _pack_idx_by_hand(arr, 0x08)
+    gz = tmp_path / "r.idx.gz"
+    gz.write_bytes(gzip.compress(plain.read_bytes()))
+    np.testing.assert_array_equal(read_idx(plain), arr)
+    np.testing.assert_array_equal(read_idx(gz), arr)
+
+
+def test_read_idx_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.idx"
+    p.write_bytes(b"\x01\x02\x03\x04more")
+    with pytest.raises(ValueError, match="magic"):
+        read_idx(p)
+    p.write_bytes(bytes([0, 0, 0x08, 1]) + struct.pack(">I", 10) + b"short")
+    with pytest.raises(ValueError, match="payload"):
+        read_idx(p)
+
+
+@pytest.fixture()
+def mnist_dir(tmp_path):
+    """A synthetic MNIST-shaped IDX directory (gzipped, like the real
+    distribution): 64 images of 28x28 with deterministic content."""
+    rng = np.random.RandomState(7)
+    images = rng.randint(0, 256, (64, 28, 28)).astype(np.uint8)
+    labels = rng.randint(0, 10, (64,)).astype(np.uint8)
+    d = tmp_path / "mnist"
+    d.mkdir()
+    for stem, arr in [("train-images-idx3-ubyte", images),
+                      ("train-labels-idx1-ubyte", labels)]:
+        tmp = d / stem
+        write_idx(tmp, arr)
+        (d / f"{stem}.gz").write_bytes(gzip.compress(tmp.read_bytes()))
+        tmp.unlink()  # only the .gz form, as downloaded
+    return d, images, labels
+
+
+def test_import_mnist_to_records_and_loader_parity(mnist_dir, tmp_path):
+    d, images, labels = mnist_dir
+    rec = import_mnist(d, tmp_path / "out")
+    from distributed_tensorflow_guide_tpu.data.importers import MNIST_FIELDS
+
+    # unshuffled Python stream must reproduce the arrays record-for-record
+    py = PyRecordLoader(rec, MNIST_FIELDS, batch_size=16, shuffle=False)
+    got_img, got_lbl = [], []
+    for _ in range(py.batches_per_epoch):
+        b = py.next_batch()
+        got_img.append(b["image"])
+        got_lbl.append(b["label"])
+    np.testing.assert_array_equal(np.concatenate(got_img),
+                                  images[..., None])
+    np.testing.assert_array_equal(np.concatenate(got_lbl),
+                                  labels.astype(np.int32))
+
+    # decode: the normalization TF's reader applied
+    dec = decode_mnist_batch({"image": images[..., None], "label": labels})
+    assert dec["image"].dtype == np.float32
+    assert dec["image"].max() <= 1.0 and dec["image"].min() >= 0.0
+
+    # byte parity through the NATIVE loader (shuffled: same seed ⇒ same
+    # stream as the Python twin — the loaders' shared-contract test,
+    # here on real imported records rather than self-synthesized ones)
+    if load_native_lib() is None:
+        pytest.skip("no C++ toolchain")
+    nat = NativeRecordLoader(rec, MNIST_FIELDS, batch_size=16, seed=3)
+    pyt = PyRecordLoader(rec, MNIST_FIELDS, batch_size=16, seed=3)
+    for _ in range(2 * nat.batches_per_epoch):
+        bn, bp = nat.next_batch(), pyt.next_batch()
+        np.testing.assert_array_equal(bn["image"], bp["image"])
+        np.testing.assert_array_equal(bn["label"], bp["label"])
+    nat.close()
+
+
+def test_import_mnist_idempotent(mnist_dir, tmp_path):
+    d, _, _ = mnist_dir
+    rec1 = import_mnist(d, tmp_path / "out")
+    mtime = rec1.stat().st_mtime_ns
+    rec2 = import_mnist(d, tmp_path / "out")
+    assert rec1 == rec2 and rec2.stat().st_mtime_ns == mtime  # no rewrite
+
+
+def test_import_idx_pair_validates(tmp_path):
+    imgs = tmp_path / "i.idx"
+    lbls = tmp_path / "l.idx"
+    write_idx(imgs, np.zeros((4, 5, 5), np.uint8))
+    write_idx(lbls, np.zeros((3,), np.uint8))  # wrong count
+    with pytest.raises(ValueError, match="pair"):
+        import_idx_pair(imgs, lbls, tmp_path / "o.records")
+
+
+def test_mnist_example_trains_from_imported_records(mnist_dir):
+    """The verdict's acceptance bar: ``mnist_sync_dp.py --data <dir>``
+    trains from imported records end-to-end (subprocess, fake devices)."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    d, _, _ = mnist_dir
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, str(repo / "examples" / "mnist_sync_dp.py"),
+         "--steps", "4", "--global-batch", "32", "--fake-devices", "4",
+         "--log-every", "0", "--data", str(d)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=repo,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "native loader: 64 records" in r.stdout, r.stdout
+    assert "done: 4 steps" in r.stdout
