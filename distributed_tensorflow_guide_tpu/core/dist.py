@@ -85,6 +85,22 @@ def ensure_platform_from_env(*, strict: bool = True) -> None:
     """
     plat = os.environ.get("JAX_PLATFORMS")
     ndev = os.environ.get("JAX_NUM_CPU_DEVICES")
+    # Parse the env var OUTSIDE the config-update try block so its named
+    # error can only ever mean the env var (a ValueError from the config
+    # updates below would otherwise be mislabeled as a bad device count).
+    ndev_int = None
+    if ndev:
+        try:
+            ndev_int = int(ndev)
+        except ValueError as e:
+            # Malformed JAX_NUM_CPU_DEVICES (e.g. "4,4"): name the env var
+            # in strict mode; best-effort callers ignore it like any other
+            # un-applicable setting.
+            if strict:
+                raise ValueError(
+                    f"JAX_NUM_CPU_DEVICES={ndev!r} is not an integer"
+                ) from e
+            log.debug("platform env not applied (malformed): %s", e)
     try:
         if plat and jax.config.jax_platforms != plat:
             log.info(
@@ -92,17 +108,8 @@ def ensure_platform_from_env(*, strict: bool = True) -> None:
                 plat, jax.config.jax_platforms,
             )
             jax.config.update("jax_platforms", plat)
-        if ndev and jax.config.jax_num_cpu_devices != int(ndev):
-            jax.config.update("jax_num_cpu_devices", int(ndev))
-    except ValueError as e:
-        # Malformed JAX_NUM_CPU_DEVICES (e.g. "4,4"): name the env var
-        # in strict mode; best-effort callers ignore it like any other
-        # un-applicable setting.
-        if strict:
-            raise ValueError(
-                f"JAX_NUM_CPU_DEVICES={ndev!r} is not an integer"
-            ) from e
-        log.debug("platform env not applied (malformed): %s", e)
+        if ndev_int is not None and jax.config.jax_num_cpu_devices != ndev_int:
+            jax.config.update("jax_num_cpu_devices", ndev_int)
     except RuntimeError as e:
         if strict:
             raise RuntimeError(
@@ -168,12 +175,20 @@ def initialize(config: DistConfig | None = None) -> None:
         kwargs["process_id"] = pid
     jax.distributed.initialize(**kwargs)
     _initialized = True
+    from distributed_tensorflow_guide_tpu.core.mesh import num_slices
+
+    n_slices = num_slices()
     log.info(
-        "distributed init: process %d/%d, %d local / %d global devices",
+        "distributed init: process %d/%d, %d local / %d global devices, "
+        "%d slice(s)%s",
         jax.process_index(),
         jax.process_count(),
         jax.local_device_count(),
         jax.device_count(),
+        n_slices,
+        "" if n_slices == 1 else
+        " — build_mesh will lay dcn_axis across slices (DCN), all other "
+        "axes within-slice (ICI)",
     )
 
 

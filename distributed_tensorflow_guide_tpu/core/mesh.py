@@ -77,9 +77,77 @@ class MeshSpec:
         return sizes
 
 
+def num_slices(devices: Sequence[jax.Device] | None = None) -> int:
+    """Number of TPU slices the devices span (1 on single-slice / CPU).
+
+    Multi-slice (Megascale / multi-pod) deployments expose
+    ``device.slice_index``; within a slice links are ICI, across slices
+    they are DCN — orders of magnitude slower, so the mesh layout must put
+    exactly one low-traffic axis across that boundary."""
+    devices = list(devices if devices is not None else jax.devices())
+    return len({getattr(d, "slice_index", 0) for d in devices})
+
+
+def _slice_groups(devices: Sequence) -> list[list]:
+    groups: dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    return [groups[k] for k in sorted(groups)]
+
+
+def hybrid_device_array(
+    sizes: dict[str, int],
+    devices: Sequence,
+    n_slices: int,
+    dcn_axis: str = "data",
+):
+    """Device array for a multi-slice mesh: ``dcn_axis`` factors as
+    (slice, within-slice) with the slice-spanning part OUTERMOST, every
+    other axis entirely within a slice — so ``model``/``pipe``/``context``
+    /``expert`` neighbors (and the within-slice part of ``data``) ride
+    ICI, and only ``dcn_axis``'s outer loop crosses DCN.
+
+    Prefers ``mesh_utils.create_hybrid_device_mesh`` (ICI-aware per-slice
+    layout); falls back to per-slice reshape + stack when topology info is
+    unavailable (fake/test devices) — slice grouping is preserved either
+    way, which is the property that matters for DCN traffic.
+    """
+    if dcn_axis not in AXES:
+        raise ValueError(f"dcn_axis must be one of {AXES}, got {dcn_axis!r}")
+    if sizes[dcn_axis] % n_slices:
+        raise ValueError(
+            f"{n_slices} slices need axis {dcn_axis!r} divisible by the "
+            f"slice count, got {sizes[dcn_axis]} — either resize "
+            f"{dcn_axis!r} or pick another dcn_axis"
+        )
+    per_slice = dict(sizes)
+    per_slice[dcn_axis] //= n_slices
+    inner = tuple(per_slice[a] for a in AXES)
+    dcn = tuple(n_slices if a == dcn_axis else 1 for a in AXES)
+    try:
+        from jax.experimental import mesh_utils
+
+        return mesh_utils.create_hybrid_device_mesh(
+            inner, dcn, devices=list(devices)
+        )
+    except Exception as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "create_hybrid_device_mesh failed (%s); falling back to "
+            "per-slice reshape — slice grouping kept, per-slice ICI "
+            "ordering may be suboptimal", e,
+        )
+        groups = _slice_groups(devices)
+        arrs = [np.asarray(g, dtype=object).reshape(inner) for g in groups]
+        return np.concatenate(arrs, axis=AXES.index(dcn_axis))
+
+
 def build_mesh(
     spec: MeshSpec | None = None,
     devices: Sequence[jax.Device] | None = None,
+    *,
+    dcn_axis: str = "data",
 ) -> Mesh:
     """Build a ``jax.sharding.Mesh`` over ``devices`` (default: all).
 
@@ -87,10 +155,21 @@ def build_mesh(
     maps onto the physical ICI torus with nearest-neighbor rings per axis
     (critical for ppermute/psum bandwidth); falls back to a plain reshape on
     backends with no topology info (CPU fake devices in tests).
+
+    Multi-slice deployments (``num_slices() > 1``) get the hybrid layout:
+    ``dcn_axis`` (default ``data`` — one gradient allreduce per step is
+    the cheapest thing to put on the slow network) spans slices, all other
+    axes stay inside a slice on ICI. Without this, a naive reshape would
+    silently scatter ``model``/``pipe`` neighbors across DCN.
     """
     spec = spec or MeshSpec()
     devices = list(devices if devices is not None else jax.devices())
     sizes = spec.resolve(len(devices))
+    n_slices = num_slices(devices)
+    if n_slices > 1:
+        return Mesh(
+            hybrid_device_array(sizes, devices, n_slices, dcn_axis), AXES
+        )
     shape = tuple(sizes[a] for a in AXES)
     try:
         from jax.experimental import mesh_utils

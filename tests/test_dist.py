@@ -34,9 +34,12 @@ def test_strict_raises_actionable_after_backend_live(monkeypatch, devices):
     # the devices fixture guarantees a live CPU backend (required even when
     # this test runs in isolation), so a conflicting request cannot be
     # applied; strict mode must say what to do about it
+    n_live = len(devices)
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
-    monkeypatch.setenv("JAX_NUM_CPU_DEVICES", "3")  # != the live 8
+    # any count != the live one conflicts; derive it so the test tracks
+    # the fixture instead of hard-coding its device count
+    monkeypatch.setenv("JAX_NUM_CPU_DEVICES", str(n_live + 1))
     with pytest.raises(RuntimeError, match="initialize\\(\\) must run"):
         ensure_platform_from_env(strict=True)
     ensure_platform_from_env(strict=False)  # best-effort degrades to a log
-    assert jax.device_count() == 8  # nothing changed
+    assert jax.device_count() == n_live  # nothing changed

@@ -60,3 +60,78 @@ def test_mesh_subset_of_devices():
     mesh = build_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
     assert mesh.devices.size == 4
     assert np.all(mesh.devices.ravel() == np.asarray(jax.devices()[:4]))
+
+
+class _SliceDev:
+    """CPU device proxy with a fake ``slice_index`` (multi-slice stand-in)."""
+
+    def __init__(self, dev, slice_index):
+        self._dev = dev
+        self.slice_index = slice_index
+
+    def __getattr__(self, name):
+        return getattr(self._dev, name)
+
+    def __repr__(self):  # pragma: no cover - debug ergonomics
+        return f"SliceDev(slice={self.slice_index}, {self._dev})"
+
+
+def _two_slice_devices():
+    devs = jax.devices()[:8]
+    return [_SliceDev(d, i // 4) for i, d in enumerate(devs)]
+
+
+def test_num_slices_detection():
+    from distributed_tensorflow_guide_tpu.core.mesh import num_slices
+
+    assert num_slices(jax.devices()) == 1  # CPU devices: no slice_index
+    assert num_slices(_two_slice_devices()) == 2
+
+
+def test_hybrid_array_keeps_axes_within_slices():
+    """The DCN property: with dcn_axis='data', every (model, pipe, ...)
+    neighbor pair — and the INNER part of data — must be same-slice; only
+    data's outer (slice) loop crosses the DCN boundary."""
+    from distributed_tensorflow_guide_tpu.core.mesh import hybrid_device_array
+
+    devs = _two_slice_devices()
+    sizes = {"data": 4, "model": 2, "pipe": 1, "context": 1, "expert": 1}
+    arr = hybrid_device_array(sizes, devs, 2, "data")
+    assert arr.shape == (4, 2, 1, 1, 1)
+    slice_of = np.vectorize(lambda d: d.slice_index)(arr)
+    # outer data index 0..1 -> slice 0, 2..3 -> slice 1 (slice-major)
+    assert np.all(slice_of[:2] == 0) and np.all(slice_of[2:] == 1)
+    # model-axis neighbors always same slice
+    assert np.all(slice_of[:, 0] == slice_of[:, 1])
+
+
+def test_hybrid_array_dcn_axis_pipe():
+    """Cross-slice pipelining: pipe spans DCN, data stays within-slice."""
+    from distributed_tensorflow_guide_tpu.core.mesh import hybrid_device_array
+
+    devs = _two_slice_devices()
+    sizes = {"data": 4, "model": 1, "pipe": 2, "context": 1, "expert": 1}
+    arr = hybrid_device_array(sizes, devs, 2, "pipe")
+    assert arr.shape == (4, 1, 2, 1, 1)
+    slice_of = np.vectorize(lambda d: d.slice_index)(arr)
+    assert np.all(slice_of[:, :, 0] == 0) and np.all(slice_of[:, :, 1] == 1)
+
+
+def test_hybrid_array_validates_divisibility():
+    from distributed_tensorflow_guide_tpu.core.mesh import hybrid_device_array
+
+    devs = _two_slice_devices()
+    sizes = {"data": 1, "model": 8, "pipe": 1, "context": 1, "expert": 1}
+    with pytest.raises(ValueError, match="divisible by the slice count"):
+        hybrid_device_array(sizes, devs, 2, "data")
+    with pytest.raises(ValueError, match="dcn_axis"):
+        hybrid_device_array(sizes, devs, 2, "bogus")
+
+
+def test_build_mesh_routes_multi_slice_to_hybrid():
+    """build_mesh with fake 2-slice devices produces the hybrid layout
+    (slice-major data axis) without the caller doing anything."""
+    mesh = build_mesh(MeshSpec(data=-1, model=2), devices=_two_slice_devices())
+    assert mesh.devices.shape == (4, 2, 1, 1, 1)
+    slice_of = np.vectorize(lambda d: d.slice_index)(mesh.devices)
+    assert np.all(slice_of[:2] == 0) and np.all(slice_of[2:] == 1)
