@@ -38,10 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 import distributed_tensorflow_guide_tpu.collectives as cc
 from distributed_tensorflow_guide_tpu.core.mesh import axis_sizes
-from distributed_tensorflow_guide_tpu.utils.spec_utils import (
-    assign_by_shape,
-    expand_prefix,
-)
+from distributed_tensorflow_guide_tpu.utils.spec_utils import expand_prefix
 from distributed_tensorflow_guide_tpu.models.transformer import (
     Block,
     TransformerConfig,

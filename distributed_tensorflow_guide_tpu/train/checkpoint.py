@@ -38,28 +38,77 @@ class Checkpointer:
             ),
         )
 
-    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+    def save(self, step: int, state: Any, *, force: bool = False,
+             layout: dict | None = None) -> bool:
+        """``layout``: optional layout-identity dict (e.g. a pipelined
+        model's ``layout_metadata()``) written as a sidecar and validated
+        on restore. Guards against shape-identical-but-permuted trees:
+        an interleaved (P=2, v=2) stage stack restores cleanly into a
+        (P=4, v=1) model — same shapes, wrong layer order — unless the
+        layout is pinned."""
         if step in self._mngr.all_steps():  # labels are immutable step counts
             return False
         saved = self._mngr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
         if saved:
+            sidecar = self.directory / f"layout_{step}.json"
+            if layout is not None:
+                import json
+
+                sidecar.write_text(json.dumps(layout, sort_keys=True))
+            else:
+                # a layout-less save must invalidate any orphaned sidecar
+                # from an earlier run that reused this step number
+                sidecar.unlink(missing_ok=True)
+            self._gc_sidecars()
             log.info("saved checkpoint at step %d -> %s", step, self.directory)
         return saved
+
+    def _gc_sidecars(self) -> None:
+        """Drop sidecars whose step was garbage-collected by orbax
+        (max_to_keep) — a stale layout_{n}.json would otherwise poison a
+        later run that reuses step n in this directory."""
+        live = set(self._mngr.all_steps())
+        for p in self.directory.glob("layout_*.json"):
+            try:
+                n = int(p.stem.removeprefix("layout_"))
+            except ValueError:  # pragma: no cover - foreign file
+                continue
+            if n not in live:
+                p.unlink(missing_ok=True)
 
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
 
-    def restore(self, state_like: Any, step: int | None = None) -> Any:
+    def restore(self, state_like: Any, step: int | None = None, *,
+                layout: dict | None = None) -> Any:
         """Restore into the structure/shardings of ``state_like``.
 
         ``state_like`` may be a concrete state (its values are discarded) or
         a tree of jax.ShapeDtypeStruct with shardings attached.
+
+        ``layout``: the restoring model's layout-identity dict; compared
+        against the sidecar written at save time (see :meth:`save`) and
+        mismatches raise instead of silently restoring permuted weights.
+        A checkpoint saved without layout metadata skips the check.
         """
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        if layout is not None:
+            sidecar = self.directory / f"layout_{step}.json"
+            if sidecar.exists():
+                import json
+
+                saved = json.loads(sidecar.read_text())
+                if saved != layout:
+                    raise ValueError(
+                        f"checkpoint layout mismatch at step {step}: saved "
+                        f"{saved}, restoring model expects {layout} — same "
+                        "tree shapes do NOT imply the same layer order "
+                        "(e.g. interleaved virtual-chunk stacks)"
+                    )
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
         return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
 

@@ -2,6 +2,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 from flax.training import train_state
 
 from distributed_tensorflow_guide_tpu.models.mnist_cnn import MNISTCNN
@@ -143,3 +144,47 @@ def test_sharded_fsdp_roundtrip(tmp_path):
     big = max(jax.tree.leaves(restored.params), key=lambda l: l.size)
     assert "data" in tuple(s for s in big.sharding.spec if s)
     ckpt.close()
+
+
+def test_layout_sidecar_refuses_permuted_restore(tmp_path):
+    """ADVICE round 3: a (P=2, v=2) interleaved stage stack is
+    shape-identical to a (P=4, v=1) stack, so orbax restores one into the
+    other silently — with the wrong layer order. The layout sidecar must
+    turn that into a loud error (and allow the matching restore)."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        TransformerConfig,
+    )
+    from distributed_tensorflow_guide_tpu.parallel.pipeline import PipelinedLM
+    from distributed_tensorflow_guide_tpu.train.checkpoint import Checkpointer
+
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=8, num_heads=2, d_model=16, d_ff=32,
+        max_len=8, causal=True, dtype=jnp.float32,
+    )
+    mesh22 = build_mesh(MeshSpec(data=-1, pipe=2))
+    pp22 = PipelinedLM(mesh22, cfg, num_microbatches=2, virtual_chunks=2)
+    params = pp22.init_params(jax.random.PRNGKey(0))
+
+    ck = Checkpointer(tmp_path / "ck")
+    ck.save(1, params, layout=pp22.layout_metadata())
+    ck.wait()
+
+    # matching layout restores fine
+    restored = ck.restore(params, layout=pp22.layout_metadata())
+    assert jax.tree.structure(restored) == jax.tree.structure(params)
+
+    # shape-identical but permuted layout must refuse — first PROVE the
+    # premise: the two stage stacks really are indistinguishable by shape
+    mesh41 = build_mesh(MeshSpec(data=-1, pipe=4))
+    pp41 = PipelinedLM(mesh41, cfg, num_microbatches=2)
+    params41 = pp41.init_params(jax.random.PRNGKey(0))
+    assert (
+        [(leaf.shape, leaf.dtype) for leaf in jax.tree.leaves(params)]
+        == [(leaf.shape, leaf.dtype) for leaf in jax.tree.leaves(params41)]
+    )
+    with pytest.raises(ValueError, match="layout mismatch"):
+        ck.restore(params41, layout=pp41.layout_metadata())
+    ck.close()

@@ -193,3 +193,51 @@ def test_fallback_is_observable(caplog):
         (k_, v_) for k_, v_ in after.items() if v_ > 1
     ):
         assert any("falling back" in r.message for r in caplog.records)
+
+
+def test_in_auto_mesh_probe_pinned():
+    """_in_auto_mesh guards the flash<->TP composition. Its legacy-context
+    branch imports jax internals (jax 0.9 has no public accessor for the
+    legacy ``with mesh:`` context: jax.sharding.get_mesh reads only the
+    set_mesh context and raises under tracing). This test FAILS — not
+    warns — when a JAX upgrade moves the probe, so flash-under-
+    TensorParallel can't silently stop engaging custom_partitioning
+    (round-3 verdict weak 6)."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.ops.flash_attention import (
+        _in_auto_mesh,
+    )
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)  # degrade -> failure
+        assert _in_auto_mesh() is False  # no mesh context: raw kernel path
+
+        # the real call site runs during jit TRACING under the legacy
+        # context — probe must see the mesh there (thread-local env)
+        seen = []
+
+        def f(x):
+            seen.append(_in_auto_mesh())
+            return x
+
+        with mesh:
+            jax.jit(f).lower(jnp.zeros(4))
+        assert seen == [True]
+
+        # inside shard_map (Manual axes) the raw per-device call is right
+        seen_sm = []
+
+        def body(x):
+            seen_sm.append(_in_auto_mesh())
+            return x
+
+        jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=jax.sharding.PartitionSpec("data"),
+            out_specs=jax.sharding.PartitionSpec("data"), check_vma=False,
+        )).lower(jnp.zeros(len(jax.devices())))
+        assert seen_sm == [False]
