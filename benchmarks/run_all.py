@@ -58,6 +58,9 @@ SMOKE = {
     "bench_sp_comm.py":
         ["--fake-devices", "8", "--context", "4", "--seq-len", "256",
          "--heads", "8", "--head-dim", "16"],
+    "bench_resnet_native_input.py":
+        ["--fake-devices", "4", "--global-batch", "16", "--records", "128",
+         "--steps", "3", "--image-size", "64"],
 }
 
 
