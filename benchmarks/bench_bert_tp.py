@@ -26,6 +26,10 @@ def main() -> None:
     ap.add_argument("--attn", choices=["auto", "dense", "flash"],
                     default="auto",
                     help="flash composes with TP via custom_partitioning")
+    ap.add_argument("--megatron-sp", action="store_true",
+                    help="MEGATRON_SP_RULES: sequence-shard the residual "
+                         "stream over the model axis (gather/scatter at "
+                         "sub-layer boundaries instead of allreduce)")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -43,7 +47,10 @@ def main() -> None:
         bert_base,
         make_cls_loss_fn,
     )
-    from distributed_tensorflow_guide_tpu.parallel.tensor import TensorParallel
+    from distributed_tensorflow_guide_tpu.parallel.tensor import (
+        MEGATRON_SP_RULES,
+        TensorParallel,
+    )
 
     initialize()
     mesh = build_mesh(MeshSpec(data=-1, model=args.model_parallel))
@@ -53,7 +60,8 @@ def main() -> None:
         bert_base(num_classes=2, dtype=jnp.bfloat16),
         num_layers=args.layers, max_len=args.seq_len, attn_impl=args.attn)
     model = Transformer(cfg)
-    tp = TensorParallel(mesh)
+    tp = (TensorParallel(mesh, rules=MEGATRON_SP_RULES)
+          if args.megatron_sp else TensorParallel(mesh))
 
     sample = jnp.zeros((1, cfg.max_len), jnp.int32)
     params, shardings = tp.init_params(model, jax.random.PRNGKey(0), sample)

@@ -166,8 +166,14 @@ def record_bytes(fields: Sequence[Field]) -> int:
 
 
 def write_records(path: str | Path, columns: Mapping[str, np.ndarray],
-                  fields: Sequence[Field]) -> int:
-    """Pack columns (leading dim = record index) into the flat record file."""
+                  fields: Sequence[Field], *, append: bool = False) -> int:
+    """Pack columns (leading dim = record index) into the flat record file.
+
+    ``append=True`` extends an existing file (records are headerless and
+    fixed-size, so concatenation is the file format's only structure) —
+    lets large datasets be written in bounded-memory chunks without
+    round-tripping each chunk through a temp file.
+    """
     n = len(next(iter(columns.values())))
     rb = record_bytes(fields)
     buf = np.zeros((n, rb), np.uint8)
@@ -179,7 +185,8 @@ def write_records(path: str | Path, columns: Mapping[str, np.ndarray],
         flat = col.reshape(n, -1).view(np.uint8).reshape(n, f.nbytes)
         buf[:, off:off + f.nbytes] = flat
         off += f.nbytes
-    Path(path).write_bytes(buf.tobytes())
+    with open(path, "ab" if append else "wb") as fh:
+        fh.write(buf.tobytes())
     return n
 
 

@@ -31,6 +31,10 @@ from distributed_tensorflow_guide_tpu.collectives import (
     tp_allreduce,
     tp_identity,
 )
+from distributed_tensorflow_guide_tpu.utils.activation_sharding import (
+    activation_mesh,  # noqa: F401 - re-export (strategy API lived here first)
+    constrain as _constrain,
+)
 
 Dtype = Any
 
@@ -141,6 +145,13 @@ def _dense_init(*names):
     )
 
 
+# Binding activation constraints: see utils/activation_sharding.py — the
+# strategy (parallel/tensor.py) enters ``activation_mesh`` at trace time
+# and these modules' ``_constrain`` sites lower to real
+# with_sharding_constraint ops; outside that context they stay advisory
+# (shard_map paths must not emit wsc).
+
+
 class MultiHeadAttention(nn.Module):
     cfg: TransformerConfig
 
@@ -159,9 +170,15 @@ class MultiHeadAttention(nn.Module):
             name="qkv",
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B, S, H, hd)
-        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
-        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
-        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
+        # "seq_inner": inside a sub-layer the sequence dim is deliberately
+        # a DIFFERENT logical axis from the residual stream's "seq" — under
+        # Megatron-SP rules "seq" maps to the model axis (sequence-sharded
+        # residual stream) while "seq_inner" stays unsharded, so attention
+        # and the MLP see the full sequence on a head/ff shard and GSPMD
+        # places the all-gather/reduce-scatter pair at the boundary.
+        q = _constrain(q, ("batch", "seq_inner", "heads", "kv"))
+        k = _constrain(k, ("batch", "seq_inner", "heads", "kv"))
+        v = _constrain(v, ("batch", "seq_inner", "heads", "kv"))
 
         if cfg.resolve_attn_impl(x.shape[1]) == "flash":
             from distributed_tensorflow_guide_tpu.ops.flash_attention import (
@@ -214,7 +231,7 @@ class MLP(nn.Module):
             name="up",
         )(x)
         y = nn.gelu(y)
-        y = nn.with_logical_constraint(y, ("batch", "seq", "mlp"))
+        y = _constrain(y, ("batch", "seq_inner", "mlp"))
         y = nn.Dense(
             cfg.d_model,
             dtype=cfg.dtype,
@@ -241,7 +258,7 @@ class Block(nn.Module):
         x = x + MLP(cfg, name="mlp")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
         )
-        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        return _constrain(x, ("batch", "seq", "embed"))
 
 
 class Transformer(nn.Module):
@@ -268,7 +285,7 @@ class Transformer(nn.Module):
             name="pos_emb",
         )(jnp.arange(tokens.shape[1])[None, :])
         x = x + pos
-        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        x = _constrain(x, ("batch", "seq", "embed"))
 
         block = Block
         if cfg.remat:

@@ -1,0 +1,49 @@
+"""Binding activation-sharding constraints — strategy↔model plumbing.
+
+Under the legacy ``with mesh:`` trace context the pjit strategies must use
+(see parallel/tensor.py's set_mesh/flax-boxing note), a bare
+``nn.with_logical_constraint`` cannot resolve a mesh and silently degrades
+to a no-op. Passing the mesh EXPLICITLY makes the constraint a real
+``jax.lax.with_sharding_constraint`` in any context — which is what lets
+Megatron-SP (residual-stream sequence sharding) actually bind.
+
+The mesh travels via a trace-time contextvar so model code stays
+mesh-agnostic: strategies enter :func:`activation_mesh` around tracing,
+models route their constraint sites through :func:`constrain`. Manual-SPMD
+paths (shard_map pipelines, where a wsc would be wrong) never set the
+contextvar and keep the advisory behavior. Lives in utils so any model
+family can use it without importing another model's module.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Any
+
+import flax.linen as nn
+
+_ACT_MESH: contextvars.ContextVar[Any] = contextvars.ContextVar(
+    "dtg_activation_mesh", default=None
+)
+
+
+@contextlib.contextmanager
+def activation_mesh(mesh):
+    """Trace-time context: make :func:`constrain` sites BINDING against
+    ``mesh`` (TensorParallel enters this inside its step)."""
+    token = _ACT_MESH.set(mesh)
+    try:
+        yield
+    finally:
+        _ACT_MESH.reset(token)
+
+
+def constrain(x, names):
+    """``nn.with_logical_constraint`` that binds when a strategy has
+    provided a mesh via :func:`activation_mesh`, and stays advisory
+    otherwise."""
+    mesh = _ACT_MESH.get()
+    if mesh is not None:
+        return nn.with_logical_constraint(x, names, mesh=mesh)
+    return nn.with_logical_constraint(x, names)
