@@ -36,8 +36,15 @@ def main() -> None:
                          "activations at the pipeline depth and measured "
                          "+25% tokens/sec on-chip (46.8k vs 37.3k, seq 512)")
     ap.add_argument("--virtual-chunks", type=int, default=1,
-                    help="interleaved GPipe: layer chunks per device "
-                         "(gpipe schedule only; bubble shrinks ~v-fold)")
+                    help="interleaved pipelining: layer chunks per device "
+                         "(bubble shrinks ~v-fold); with --schedule 1f1b "
+                         "this is Megatron's combined schedule (also keeps "
+                         "the O(P) activation cap; needs microbatches % "
+                         "pipe == 0)")
+    ap.add_argument("--model-parallel", type=int, default=1,
+                    help="TP degree INSIDE each pipeline stage (Megatron "
+                         "f/g inside shard_map) — dp x tp x pp in one "
+                         "program when combined with --pipe and data fill")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -60,7 +67,8 @@ def main() -> None:
     from distributed_tensorflow_guide_tpu.parallel.pipeline import PipelinedLM
 
     initialize()
-    mesh = build_mesh(MeshSpec(data=-1, pipe=args.pipe))
+    mesh = build_mesh(MeshSpec(data=-1, pipe=args.pipe,
+                               model=args.model_parallel))
     sizes = axis_sizes(mesh)
     if args.small:
         cfg = TransformerConfig(
