@@ -105,6 +105,12 @@ def pmean(x, axis: str | tuple[str, ...]):
     return lax.pmean(x, axis)
 
 
+def pmax(x, axis: str | tuple[str, ...]):
+    """All-reduce max — the stabilizer of vocab-parallel log-softmax."""
+    _record("pmax", axis, x)
+    return lax.pmax(x, axis)
+
+
 def all_gather(x, axis: str, *, tiled: bool = False, gather_axis: int = 0):
     """All-gather — replaces NCCL allgather per the north-star mapping."""
     _record("all_gather", axis, x)

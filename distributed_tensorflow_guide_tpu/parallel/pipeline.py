@@ -488,10 +488,18 @@ class PipelinedLM:
         # exact inside this strategy's manual-SPMD shard_map. Params are
         # initialized at global shapes and sharded by per-leaf specs
         # (:meth:`param_specs`); each device applies a LOCAL-config block on
-        # its (heads/tp, d_ff/tp) shard. Embed/head stay replicated over
-        # ``model`` (vocab-parallel loss is a further extension).
+        # its (heads/tp, d_ff/tp) shard. The vocab-sized tables shard too:
+        # the token embedding is a Megatron parallel embedding
+        # (:meth:`_embed_tokens`) and the LM head computes vocab-parallel
+        # cross-entropy (:meth:`_mb_loss_vocab_parallel`) — no device holds
+        # a full-vocab table or materializes full-vocab logits.
         self.tp = sizes["model"]
         if self.tp > 1:
+            if cfg.vocab_size % self.tp:
+                raise ValueError(
+                    f"vocab_size {cfg.vocab_size} must divide by tp "
+                    f"{self.tp} (vocab-parallel head)"
+                )
             self.block_apply = Block(cfg.tp_local(self.tp, axis="model"))
             abs_block = jax.eval_shape(
                 self.block.init,
@@ -502,6 +510,10 @@ class PipelinedLM:
                 lambda path, _: self._stage_leaf_spec(path),
                 nn.meta.unbox(abs_block),
             )
+            # vocab-parallel cross-entropy needs the raw LN to apply with
+            # explicit params (the _Head module computes full-vocab logits,
+            # which is exactly what vocab parallelism avoids)
+            self._head_ln = nn.LayerNorm(dtype=cfg.dtype)
         else:
             self.block_apply = self.block
 
@@ -580,9 +592,14 @@ class PipelinedLM:
 
     def param_specs(self) -> dict:
         """Spec tree: stage stack sharded over pipe (and, when the mesh has
-        a ``model`` axis, Megatron-TP over it per leaf), rest replicated."""
+        a ``model`` axis, Megatron-TP over it per leaf; the LM-head kernel
+        vocab-sharded over it), rest replicated."""
         if self.tp > 1:
-            return {"embed": P(), "stages": self._stage_specs_tp, "head": P()}
+            return {
+                "embed": {"tok_emb": P("model"), "pos_emb": P()},
+                "stages": self._stage_specs_tp,
+                "head": {"ln_f": P(), "lm_head": P(None, "model")},
+            }
         return {"embed": P(), "stages": P("pipe"), "head": P()}
 
     def param_shardings(self):
@@ -645,12 +662,46 @@ class PipelinedLM:
         out, _ = lax.scan(body, x, stage_params)
         return out
 
+    def _embed_tokens(self, embed_params, tokens):
+        """(B, S) int32 -> (B, S, D) cfg.dtype — THE embedding path, shared
+        by the all-microbatch forward and the 1F1B embed-grad branches.
+
+        Under TP the token table is vocab-sharded over ``model`` (Megatron
+        parallel embedding): each device holds V/tp rows, looks up only
+        the tokens that fall in its slice (masked gather), and one
+        ``tp_allreduce`` (psum fwd, identity bwd — so each shard's rows
+        receive exactly their own cotangents) assembles the full
+        embedding. Positional table stays replicated (max_len × D is
+        small)."""
+        cfg = self.cfg
+        if self.tp > 1:
+            v_local = cfg.vocab_size // self.tp
+            shard = lax.axis_index("model")
+            W = embed_params["tok_emb"]["embedding"]  # (V/tp, D) local
+            local_id = tokens - shard * v_local
+            ok = (local_id >= 0) & (local_id < v_local)
+            # cast to the activation dtype BEFORE the collective: matches
+            # nn.Embed's compute dtype on the tp=1 path and halves the
+            # psum's wire bytes under bf16
+            e_local = (
+                W[jnp.clip(local_id, 0, v_local - 1)]
+                * ok[..., None].astype(W.dtype)
+            ).astype(cfg.dtype)
+            e = cc.tp_allreduce(e_local, "model")
+            pos = embed_params["pos_emb"]["embedding"][
+                jnp.arange(tokens.shape[1])
+            ][None].astype(cfg.dtype)
+            return e + pos
+        return self.embedder.apply(
+            {"params": embed_params}, tokens
+        ).astype(cfg.dtype)
+
     def _embed_all(self, embed_params, tokens_mbs):
         """Embed all M microbatches at once: (M, mb, S) -> (M, mb, S, D)."""
         M, mb, S = tokens_mbs.shape
         flat = tokens_mbs.reshape(M * mb, S)
-        e = self.embedder.apply({"params": embed_params}, flat)
-        return e.reshape(M, mb, S, self.cfg.d_model).astype(self.cfg.dtype)
+        e = self._embed_tokens(embed_params, flat)
+        return e.reshape(M, mb, S, self.cfg.d_model)
 
     def _head_loss_sum(self, head_params, finals, tokens_mbs):
         """Sum of per-microbatch head losses — the single implementation
@@ -666,15 +717,63 @@ class PipelinedLM:
     def _mb_loss(self, head_params, x, toks):
         """Head + next-token NLL for one microbatch's final activations.
 
-        The single definition shared by both schedules — gpipe and 1f1b are
+        The single definition shared by every schedule — the schedules are
         contractually gradient-identical, so the loss math must not fork.
+        Under TP it dispatches to the vocab-parallel cross-entropy.
         """
+        if self.tp > 1:
+            return self._mb_loss_vocab_parallel(head_params, x, toks)
         logits = self.head.apply({"params": head_params}, x)
         logp = jax.nn.log_softmax(logits[:, :-1])
         ll = jnp.take_along_axis(
             logp, toks[:, 1:][..., None], axis=-1
         )[..., 0]
         return -jnp.mean(ll)
+
+    def _mb_loss_vocab_parallel(self, head_params, x, toks):
+        """Megatron vocab-parallel cross-entropy (Shoeybi et al. 2019 §3):
+        the LM-head kernel is sharded over ``model`` along VOCAB, each
+        device computes logits for its vocab slice only, and the NLL is
+        assembled from three scalar-field collectives — max (stability),
+        sum-exp (partition function), and the target logit (owned by
+        exactly one shard). No device ever materializes (S, V) logits:
+        peak logits memory drops by the TP degree, which at GPT-2's 50304
+        vocab is the dominant activation on the last stage.
+
+        Collective gradient discipline (same as the block f/g pairing):
+        ``tp_allreduce`` (psum fwd, identity bwd) assembles the replicated
+        scalars so each device's local-loss cotangent stays 1; the input
+        ``x`` passes through ``tp_identity`` (identity fwd, psum bwd) so
+        dx sums every shard's vocab-slice contribution; the stabilizer max
+        is gradient-stopped (exact for logsumexp).
+        """
+        cfg = self.cfg
+        f32 = jnp.float32
+        v_local = cfg.vocab_size // self.tp
+        shard = lax.axis_index("model")
+        xh = self._head_ln.apply({"params": head_params["ln_f"]}, x)
+        xh = cc.tp_identity(xh, "model")
+        kernel = head_params["lm_head"]["kernel"]  # (D, V/tp) local shard
+        # f32 head matmul — same computation dtype _Head's Dense pins
+        z = xh[:, :-1].astype(f32) @ kernel.astype(f32)
+        targets = toks[:, 1:]
+        # stop_gradient BEFORE the collective: pmax has no differentiation
+        # rule, and the logsumexp stabilizer is exact with zero gradient
+        m = cc.pmax(
+            lax.stop_gradient(jnp.max(z, axis=-1)), "model"
+        )  # (B, S-1)
+        sumexp = cc.tp_allreduce(
+            jnp.sum(jnp.exp(z - m[..., None]), axis=-1), "model"
+        )
+        lse = jnp.log(sumexp) + m
+        local_t = targets - shard * v_local
+        in_shard = (local_t >= 0) & (local_t < v_local)
+        t_clamped = jnp.clip(local_t, 0, v_local - 1)
+        z_t_local = jnp.take_along_axis(
+            z, t_clamped[..., None], axis=-1
+        )[..., 0]
+        z_t = cc.tp_allreduce(jnp.where(in_shard, z_t_local, 0.0), "model")
+        return jnp.mean(lse - z_t)
 
     def _pipeline_loss(self, params, tokens_mbs):
         """Per-device pipeline forward + LM loss.
@@ -940,9 +1039,7 @@ class PipelinedLM:
 
                 def embed_branch():
                     _, evjp = jax.vjp(
-                        lambda ep: self.embedder.apply(
-                            {"params": ep}, toks
-                        ).astype(cfg.dtype),
+                        lambda ep: self._embed_tokens(ep, toks),
                         params["embed"],
                     )
                     (d_emb,) = evjp(dx)
@@ -1117,9 +1214,7 @@ class PipelinedLM:
 
                 def embed_branch():
                     _, evjp = jax.vjp(
-                        lambda ep: self.embedder.apply(
-                            {"params": ep}, toks
-                        ).astype(cfg.dtype),
+                        lambda ep: self._embed_tokens(ep, toks),
                         params["embed"],
                     )
                     (d_emb,) = evjp(dx)

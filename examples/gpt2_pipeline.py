@@ -36,8 +36,12 @@ def main() -> None:
                     help="use the real GPT-2 124M geometry")
     ap.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe")
     ap.add_argument("--virtual-chunks", type=int, default=1,
-                    help="interleaved GPipe: layer chunks per device "
-                         "(gpipe schedule only; bubble shrinks ~v-fold)")
+                    help="interleaved pipelining: layer chunks per device "
+                         "(bubble shrinks ~v-fold; with --schedule 1f1b "
+                         "this is Megatron's combined schedule)")
+    ap.add_argument("--model-parallel", type=int, default=1,
+                    help="TP degree inside each stage (Megatron f/g; the "
+                         "LM head goes vocab-parallel) — 3D dp x tp x pp")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -65,7 +69,8 @@ def main() -> None:
     logging.basicConfig(level=logging.INFO, format="%(message)s", force=True)
     initialize()
 
-    mesh = build_mesh(MeshSpec(data=-1, pipe=args.pipe))
+    mesh = build_mesh(MeshSpec(data=-1, pipe=args.pipe,
+                               model=args.model_parallel))
     sizes = axis_sizes(mesh)
     if args.full_gpt2:
         cfg = gpt2_124m(remat=True)

@@ -56,6 +56,9 @@ def _reference_loss(pp, params, tokens):
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 @pytest.mark.parametrize("n_pipe,n_data", [(4, 1), (2, 2)])
 def test_pipeline_matches_unpipelined(n_pipe, n_data, schedule):
+    # model fills to 2 in both shapes: these four configs are ALSO the
+    # tp>1 parity coverage for the v=1 schedules (TP-sharded stages,
+    # vocab-parallel embedding + head under both gpipe and 1f1b)
     mesh = build_mesh(MeshSpec(data=n_data, pipe=n_pipe, model=8 // (n_pipe * n_data)))
     M = 4  # microbatches
     pp = PipelinedLM(mesh, CFG, num_microbatches=M, schedule=schedule)
@@ -214,6 +217,14 @@ def test_stage_params_actually_sharded():
     leaf = jax.tree.leaves(params["stages"])[0]
     assert leaf.shape[0] == 4
     assert leaf.addressable_shards[0].data.shape[0] == 1  # one stage per device
+    # under tp the LM-head kernel is VOCAB-sharded over model (the
+    # vocab-parallel cross-entropy's precondition: no device holds full V)
+    k = params["head"]["lm_head"]["kernel"]
+    assert k.shape == (CFG.d_model, CFG.vocab_size)
+    assert k.addressable_shards[0].data.shape[1] == CFG.vocab_size // 2
+    # ... and so is the token embedding (Megatron parallel embedding)
+    w = params["embed"]["tok_emb"]["embedding"]
+    assert w.addressable_shards[0].data.shape[0] == CFG.vocab_size // 2
 
 
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
@@ -367,7 +378,11 @@ def test_interleaved_flop_discipline():
         f"interleaved step does {ratio:.2f}x the oracle's matmul FLOPs per "
         "device — non-owner head/embed are burning compute"
     )
-    assert ratio > 0.4, ratio
+    # Sanity floor: this mesh has model=2, so the vocab-parallel head puts
+    # only V/tp of the head matmul on each device (~0.37 with this
+    # head-dominated config, vs ~0.65 when the head was replicated). A
+    # ratio below this floor would mean block compute itself went missing.
+    assert ratio > 0.3, ratio
 
 
 @pytest.mark.parametrize("schedule,n_pipe,v", [("gpipe", 4, 1),
