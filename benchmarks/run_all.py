@@ -56,7 +56,9 @@ SMOKE = {
          "--d-ff", "128", "--heads", "4", "--vocab", "256",
          "--seq-len", "32", "--global-batch", "8", "--steps", "1"],
     "bench_sp_comm.py":
-        ["--fake-devices", "8", "--context", "4", "--seq-len", "256",
+        # S/context must be >= the 128-lane kernel block: the fwd and
+        # fwd+bwd rows both lower the PALLAS ring (same-impl contract)
+        ["--fake-devices", "8", "--context", "4", "--seq-len", "512",
          "--heads", "8", "--head-dim", "16"],
     "bench_resnet_native_input.py":
         ["--fake-devices", "4", "--global-batch", "16", "--records", "128",
