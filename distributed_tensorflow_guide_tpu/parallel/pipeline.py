@@ -519,6 +519,38 @@ class PipelinedLM:
 
     # -- params ---------------------------------------------------------------
     def init_params(self, rng) -> dict:
+        """Initialize and lay out onto the mesh. Single-controller path;
+        multi-controller callers build global arrays from
+        :meth:`init_host_params` (device_put cannot target another
+        process's shards)."""
+        return jax.device_put(
+            self.init_host_params(rng), self.param_shardings()
+        )
+
+    def init_params_multihost(self, rng) -> dict:
+        """Multi-controller init: every process computes the identical
+        host tree (deterministic in ``rng``) and materializes ONLY its
+        own shards via ``make_array_from_callback`` — the layout
+        ``device_put`` cannot produce when shards live on another
+        process's devices. Used by the cross-process pipeline test; the
+        entry point for real multi-host training."""
+        import numpy as np
+
+        host = jax.tree.map(np.asarray, self.init_host_params(rng))
+        full_specs = expand_prefix(self.param_specs(), host)
+        return jax.tree.map(
+            lambda h, spec: jax.make_array_from_callback(
+                h.shape, NamedSharding(self.mesh, spec),
+                lambda idx, h=h: h[idx],
+            ),
+            host, full_specs,
+            is_leaf=lambda x: isinstance(x, np.ndarray),
+        )
+
+    def init_host_params(self, rng) -> dict:
+        """The un-laid-out param tree (deterministic in ``rng`` — every
+        process computes identical values, which is what lets
+        :meth:`init_params_multihost` slice out per-process shards)."""
         cfg = self.cfg
         r_emb, r_blocks, r_head = jax.random.split(rng, 3)
         dummy_tok = jnp.zeros((1, cfg.max_len), jnp.int32)
@@ -553,8 +585,7 @@ class PipelinedLM:
                 stacked,
             )
         head = self.head.init(r_head, dummy_x)["params"]
-        params = {"embed": emb, "stages": stacked, "head": head}
-        return jax.device_put(params, self.param_shardings())
+        return {"embed": emb, "stages": stacked, "head": head}
 
     @staticmethod
     def _stage_leaf_spec(path) -> P:

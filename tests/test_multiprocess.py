@@ -150,6 +150,52 @@ def _target_fsdp_sharded_step(steps):
             "w_spec": [str(x) for x in w_spec]}
 
 
+def _target_pipeline_across_processes(steps):
+    """dp x pp pipeline TRAINING spanning processes: the pipe axis's
+    per-tick ppermute hand-offs cross the process boundary over Gloo —
+    the multi-controller capability the in-process pipeline tests don't
+    prove. Params are materialized into their global shard layout with
+    make_array_from_callback over the host-replicated init (device_put
+    cannot target another process's shards)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        TransformerConfig,
+    )
+    from distributed_tensorflow_guide_tpu.parallel.pipeline import PipelinedLM
+
+    cfg = TransformerConfig(
+        vocab_size=32, num_layers=2, num_heads=2, d_model=16, d_ff=32,
+        max_len=8, causal=True, dtype=jnp.float32,
+    )
+    mesh = build_mesh(MeshSpec(data=2, pipe=2))
+    pp = PipelinedLM(mesh, cfg, num_microbatches=2)
+    params = pp.init_params_multihost(jax.random.PRNGKey(0))
+    tx = optax.sgd(0.1)
+    opt_state = pp.init_opt_state(tx, params)
+    step = pp.make_train_step(tx, params, donate=False)
+
+    rng = np.random.RandomState(0)
+    tokens_global = rng.randint(0, cfg.vocab_size, (8, cfg.max_len)).astype(
+        np.int32
+    )
+    per = 8 // jax.process_count()
+    lo = jax.process_index() * per
+    tokens = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), tokens_global[lo:lo + per]
+    )
+    losses = []
+    for _ in range(steps):
+        opt_state, params, m = step(opt_state, params, tokens)
+        losses.append(float(m["loss"]))
+    return {"pid": jax.process_index(), "losses": losses}
+
+
 def _target_one_proc_fails():
     import jax
 
@@ -232,6 +278,53 @@ def test_fsdp_sharded_training_across_processes():
         w -= 0.1 * grad
     for r in results:
         np.testing.assert_allclose(r.result["losses"], ref, rtol=1e-4)
+
+
+def test_pipeline_training_across_processes():
+    """dp x pp across 2 processes (Gloo ppermute between them) matches the
+    in-process run of the identical config bit-for-bit at f32 tolerance —
+    the pipeline's multi-host story, not just its fake-mesh one."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        TransformerConfig,
+    )
+    from distributed_tensorflow_guide_tpu.parallel.pipeline import PipelinedLM
+
+    steps = 3
+    results = run_multiprocess(
+        _target_pipeline_across_processes, N, args=(steps,),
+        local_devices_per_process=2,
+    )
+    assert [r.ok for r in results] == [True] * N
+
+    # in-process oracle: identical config, seed and tokens on 4 local devices
+    cfg = TransformerConfig(
+        vocab_size=32, num_layers=2, num_heads=2, d_model=16, d_ff=32,
+        max_len=8, causal=True, dtype=jnp.float32,
+    )
+    mesh = build_mesh(MeshSpec(data=2, pipe=2), devices=jax.devices()[:4])
+    pp = PipelinedLM(mesh, cfg, num_microbatches=2)
+    params = pp.init_params(jax.random.PRNGKey(0))
+    tx = optax.sgd(0.1)
+    opt_state = pp.init_opt_state(tx, params)
+    step = pp.make_train_step(tx, params, donate=False)
+    tokens = jax.device_put(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (8, cfg.max_len))
+        .astype(np.int32),
+        NamedSharding(mesh, P("data")),
+    )
+    ref = []
+    for _ in range(steps):
+        opt_state, params, m = step(opt_state, params, tokens)
+        ref.append(float(m["loss"]))
+    for r in results:
+        np.testing.assert_allclose(r.result["losses"], ref, rtol=1e-5)
 
 
 def test_subprocess_failure_propagates():
