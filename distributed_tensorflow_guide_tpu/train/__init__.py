@@ -11,3 +11,8 @@ from distributed_tensorflow_guide_tpu.train.checkpoint import (  # noqa: F401
     Checkpointer,
     CheckpointHook,
 )
+from distributed_tensorflow_guide_tpu.train.elastic import (  # noqa: F401
+    PreemptionHook,
+    TooManyRestarts,
+    run_with_recovery,
+)

@@ -63,19 +63,31 @@ class TrainLoop:
         already hold poisoned arrays from the failed step, and an end-of-run
         checkpoint of it would overwrite the last good resume point
         (train/elastic.py restores strictly pre-crash checkpoints instead).
+
+        A hook may additionally define ``cleanup()``: it runs in a
+        ``finally`` on BOTH paths — the place to release process-global
+        resources (e.g. PreemptionHook's signal handlers) that must not
+        outlive a crashed loop, while keeping state-finalizing work in
+        ``end`` where crashes rightly skip it.
         """
         for h in self.hooks:
             h.begin(self)
-        it: Iterator = iter(self.data)
-        while not self._stop:
-            try:
-                batch = next(it)
-            except StopIteration:
-                break
-            self.state, metrics = self.step_fn(self.state, batch)
+        try:
+            it: Iterator = iter(self.data)
+            while not self._stop:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                self.state, metrics = self.step_fn(self.state, batch)
+                for h in self.hooks:
+                    h.after_step(self.step, metrics)
+                self.step += 1
             for h in self.hooks:
-                h.after_step(self.step, metrics)
-            self.step += 1
-        for h in self.hooks:
-            h.end(self.step)
+                h.end(self.step)
+        finally:
+            for h in self.hooks:
+                cleanup = getattr(h, "cleanup", None)
+                if cleanup is not None:
+                    cleanup()
         return self.state

@@ -239,3 +239,112 @@ def test_multiprocess_crash_and_resume(tmp_path):
         w = w - 0.05 * (2.0 / len(x)) * x.T @ (pred - y)
     for r in results:
         assert r.result["w"] == pytest.approx(w.tolist(), rel=1e-4)
+
+
+def test_preemption_sigterm_saves_and_resumes(tmp_path):
+    """Graceful preemption: SIGTERM mid-run is deferred to the step
+    boundary, a checkpoint labeled with the completed-step count is saved,
+    the loop stops cleanly — and a fresh run resuming from it produces the
+    SAME final state as an uninterrupted run (the crash-resume identity,
+    but with zero lost steps)."""
+    import os
+    import signal
+
+    from distributed_tensorflow_guide_tpu.train.elastic import PreemptionHook
+    from distributed_tensorflow_guide_tpu.train.loop import TrainLoop
+
+    # uninterrupted reference
+    state = _init_state()
+    loop = TrainLoop(_step_fn, state, _make_data(0),
+                     hooks=[StopAtStepHook(TOTAL_STEPS)])
+    ref = loop.run()
+
+    # preempted run: SIGTERM arrives DURING step 3's compute
+    ckpt = Checkpointer(tmp_path / "pre")
+    hook = PreemptionHook(ckpt)
+
+    def step(state, batch):
+        if int(batch[0]) == 3:
+            os.kill(os.getpid(), signal.SIGTERM)  # handler defers to flag
+        return _step_fn(state, batch)
+
+    original_handler = signal.getsignal(signal.SIGTERM)
+    loop1 = TrainLoop(step, _init_state(), _make_data(0),
+                      hooks=[StopAtStepHook(TOTAL_STEPS), hook])
+    mid = loop1.run()
+    assert hook.preempted_at == 4  # step 3 completed, label = count
+    assert ckpt.latest_step() == 4
+    assert loop1.step == 4  # stopped cleanly, no further steps ran
+    # the ORIGINAL handler is back (bound methods compare by identity of
+    # __self__/__func__, so == is the meaningful comparison)
+    assert signal.getsignal(signal.SIGTERM) == original_handler
+
+    # resume: restore label 4, continue to the end
+    start = ckpt.latest_step()
+    resumed = ckpt.restore(mid)
+    loop2 = TrainLoop(_step_fn, resumed, _make_data(start),
+                      hooks=[StopAtStepHook(TOTAL_STEPS)], start_step=start)
+    final = loop2.run()
+    np.testing.assert_allclose(np.asarray(final["params"]),
+                               np.asarray(ref["params"]), rtol=1e-6)
+    ckpt.close()
+
+
+def test_preemption_handler_restored_after_crash(tmp_path):
+    """A CRASHED loop must not leave the flag-only handler installed
+    process-wide (it would silently swallow the cluster manager's real
+    SIGTERM forever) — restoration runs in TrainLoop's cleanup phase,
+    which fires on the crash path where end() deliberately does not."""
+    import signal
+
+    from distributed_tensorflow_guide_tpu.train.elastic import PreemptionHook
+    from distributed_tensorflow_guide_tpu.train.loop import TrainLoop
+
+    original = signal.getsignal(signal.SIGTERM)
+    ckpt = Checkpointer(tmp_path / "crash")
+    hook = PreemptionHook(ckpt)
+
+    def bad_step(state, batch):
+        raise RuntimeError("boom")
+
+    loop = TrainLoop(bad_step, _init_state(), _make_data(0), hooks=[hook])
+    with pytest.raises(RuntimeError, match="boom"):
+        loop.run()
+    assert signal.getsignal(signal.SIGTERM) == original
+    ckpt.close()
+
+
+def test_preemption_hook_reusable_across_runs(tmp_path):
+    """A restarter reusing the hook instance: run 1 preempts and saves;
+    run 2 with the SAME instance must be able to preempt again (begin
+    resets the latch) and save its own later checkpoint."""
+    import os
+    import signal
+
+    from distributed_tensorflow_guide_tpu.train.elastic import PreemptionHook
+    from distributed_tensorflow_guide_tpu.train.loop import TrainLoop
+
+    ckpt = Checkpointer(tmp_path / "reuse", max_to_keep=5)
+    hook = PreemptionHook(ckpt)
+
+    def make_step(kill_at):
+        def step(state, batch):
+            if int(batch[0]) == kill_at:
+                os.kill(os.getpid(), signal.SIGTERM)
+            return _step_fn(state, batch)
+
+        return step
+
+    loop1 = TrainLoop(make_step(2), _init_state(), _make_data(0),
+                      hooks=[StopAtStepHook(TOTAL_STEPS), hook])
+    mid = loop1.run()
+    assert hook.preempted_at == 3
+
+    start = ckpt.latest_step()
+    loop2 = TrainLoop(make_step(6), ckpt.restore(mid), _make_data(start),
+                      hooks=[StopAtStepHook(TOTAL_STEPS), hook],
+                      start_step=start)
+    loop2.run()
+    assert hook.preempted_at == 7  # the reused instance preempted AGAIN
+    assert ckpt.latest_step() == 7
+    ckpt.close()
