@@ -38,8 +38,12 @@ SMOKE = {
     "bench_wide_deep.py":
         ["--fake-devices", "8", "--global-batch", "64", "--steps", "3"],
     "bench_gpt2_pp.py":
-        ["--fake-devices", "8", "--pipe", "2", "--small", "--microbatches",
-         "2", "--microbatch-size", "1", "--seq-len", "64", "--steps", "2"],
+        # the full 3D smoke: dp x tp x pp with the combined interleaved-
+        # 1F1B schedule — the production composition, exercised end-to-end
+        ["--fake-devices", "8", "--pipe", "2", "--model-parallel", "2",
+         "--schedule", "1f1b", "--virtual-chunks", "2", "--small",
+         "--microbatches", "2", "--microbatch-size", "1",
+         "--seq-len", "64", "--steps", "2"],
     "bench_native_input.py":
         ["--fake-devices", "8", "--global-batch", "64", "--records", "512",
          "--steps", "5"],

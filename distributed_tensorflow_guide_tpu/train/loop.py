@@ -70,9 +70,12 @@ class TrainLoop:
         outlive a crashed loop, while keeping state-finalizing work in
         ``end`` where crashes rightly skip it.
         """
-        for h in self.hooks:
-            h.begin(self)
         try:
+            # begin() inside the try: if a later hook's begin raises, the
+            # finally still runs cleanup() for already-begun hooks (e.g.
+            # PreemptionHook's process-wide signal handler)
+            for h in self.hooks:
+                h.begin(self)
             it: Iterator = iter(self.data)
             while not self._stop:
                 try:

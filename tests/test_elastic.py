@@ -348,3 +348,33 @@ def test_preemption_hook_reusable_across_runs(tmp_path):
     assert hook.preempted_at == 7  # the reused instance preempted AGAIN
     assert ckpt.latest_step() == 7
     ckpt.close()
+
+
+def test_preemption_handler_restored_when_later_hook_begin_raises(tmp_path):
+    """If a hook AFTER PreemptionHook raises in begin(), the loop must
+    still run cleanup() for the hooks already begun — otherwise the
+    flag-only SIGTERM handler leaks process-wide before a single step
+    ran."""
+    import signal
+
+    from distributed_tensorflow_guide_tpu.train.elastic import PreemptionHook
+    from distributed_tensorflow_guide_tpu.train.loop import TrainLoop
+
+    class _BadBegin:
+        def begin(self, loop):
+            raise PermissionError("cannot open metrics file")
+
+        def after_step(self, step, metrics):
+            pass
+
+        def end(self, step):
+            pass
+
+    original = signal.getsignal(signal.SIGTERM)
+    ckpt = Checkpointer(tmp_path / "bb")
+    loop = TrainLoop(_step_fn, _init_state(), _make_data(0),
+                     hooks=[PreemptionHook(ckpt), _BadBegin()])
+    with pytest.raises(PermissionError):
+        loop.run()
+    assert signal.getsignal(signal.SIGTERM) == original
+    ckpt.close()
