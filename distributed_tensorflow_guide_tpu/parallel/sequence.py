@@ -175,10 +175,21 @@ def _ring_flash_fwd_rule(q, k, v, axis, causal, scale):
 
 
 def _ring_flash_bwd_rule(axis, causal, scale, res, g):
-    """Second ring pass: dq accumulates locally; (dk, dv) partial sums
-    travel around the ring WITH their kv shard and arrive home after n
-    rotations. Reuses the flash backward kernels per rotation with the
-    same three-case static masking as forward."""
+    """Second ring pass, Q-SIDE rotation: (k, v) stay home and (dk, dv)
+    accumulate locally; the Q side — q, the output cotangent g, the
+    travelling dq partial sum, and two lane-thin softmax stats (lse's
+    first lane, delta) — rotates instead, arriving home after n hops.
+
+    Why this orientation: the KV-side rotation moves FOUR head_dim-sized
+    tensors per hop (k, v, dk-partial, dv-partial); this one moves THREE
+    plus two (B, H, S)-thin rows — ~24% less backward wire at f32 D=64
+    and ~32% at bf16 (the f32 partial dominates either way; measured by
+    bench_sp_comm's traced table, pinned in tests/test_sp_comm.py).
+    Causality flips perspective: the LOCAL kv shard at index ``my`` meets
+    the visiting q-block from ``src_q``; src_q == my is the masked
+    diagonal, src_q > my full (q after kv), src_q < my dead (skipped).
+    Reuses the flash backward kernels per rotation; lse re-broadcasts to
+    the lane width locally (broadcast is free, rotating it is not)."""
     q, k, v, out, lse = res
     n = lax.axis_size(axis)
     my = lax.axis_index(axis)
@@ -187,48 +198,51 @@ def _ring_flash_bwd_rule(axis, causal, scale, res, g):
     d = q.shape[-1]
     dp = -(-d // F.LANE) * F.LANE
     delta = jnp.sum(g.astype(f32) * out.astype(f32), axis=-1)  # (B,H,S)
-    qp = _pad_lane(q, d, dp)       # local: pad once; rotations stay unpadded
-    gp = _pad_lane(g, d, dp)
+    kp = _pad_lane(k, d, dp)       # local + stationary: pad once
+    vp = _pad_lane(v, d, dp)
 
     def run(diag):
-        def go(k_cur, v_cur):
+        def go(q_cur, g_cur, lse1_cur, delta_cur):
+            lse_b = jnp.broadcast_to(lse1_cur, (*lse1_cur.shape[:-1], F.LANE))
             dq_s, dk_s, dv_s = F._bwd_call(
-                qp, _pad_lane(k_cur, d, dp), _pad_lane(v_cur, d, dp),
-                gp, lse, delta, scale=scale, causal=diag,
-                blk_q=128, blk_k=128,
+                _pad_lane(q_cur, d, dp), kp, vp,
+                _pad_lane(g_cur, d, dp), lse_b, delta_cur,
+                scale=scale, causal=diag, blk_q=128, blk_k=128,
             )
             return (dq_s[..., :d].astype(f32), dk_s[..., :d].astype(f32),
                     dv_s[..., :d].astype(f32))
 
         return go
 
-    def skip(k_cur, v_cur):
+    def skip(q_cur, g_cur, lse1_cur, delta_cur):
         z = jnp.zeros(q.shape, f32)
         return z, z, z
 
     def body(carry, _):
-        dq, k_cur, v_cur, dk_acc, dv_acc, src = carry
+        q_cur, g_cur, lse1_cur, delta_cur, dq_cur, dk, dv, src_q = carry
         if causal:
             dq_s, dk_s, dv_s = lax.cond(
-                src == my,
+                src_q == my,
                 run(True),
-                lambda *a: lax.cond(src < my, run(False), skip, *a),
-                k_cur, v_cur,
+                lambda *a: lax.cond(src_q > my, run(False), skip, *a),
+                q_cur, g_cur, lse1_cur, delta_cur,
             )
         else:
-            dq_s, dk_s, dv_s = run(False)(k_cur, v_cur)
-        dq = dq + dq_s
-        dk_acc = dk_acc + dk_s
-        dv_acc = dv_acc + dv_s
-        k_cur = cc.ppermute(k_cur, axis, fwd)
-        v_cur = cc.ppermute(v_cur, axis, fwd)
-        dk_acc = cc.ppermute(dk_acc, axis, fwd)
-        dv_acc = cc.ppermute(dv_acc, axis, fwd)
-        return (dq, k_cur, v_cur, dk_acc, dv_acc, (src - 1) % n), None
+            dq_s, dk_s, dv_s = run(False)(q_cur, g_cur, lse1_cur, delta_cur)
+        dq_cur = dq_cur + dq_s
+        dk = dk + dk_s
+        dv = dv + dv_s
+        q_cur = cc.ppermute(q_cur, axis, fwd)
+        g_cur = cc.ppermute(g_cur, axis, fwd)
+        lse1_cur = cc.ppermute(lse1_cur, axis, fwd)
+        delta_cur = cc.ppermute(delta_cur, axis, fwd)
+        dq_cur = cc.ppermute(dq_cur, axis, fwd)
+        return (q_cur, g_cur, lse1_cur, delta_cur, dq_cur, dk, dv,
+                (src_q - 1) % n), None
 
     z = jnp.zeros(q.shape, f32)
-    (dq, _, _, dk, dv, _), _ = lax.scan(
-        body, (z, k, v, z, z, my), None, length=n
+    (_, _, _, _, dq, dk, dv, _), _ = lax.scan(
+        body, (q, g, lse[..., :1], delta, z, z, z, my), None, length=n
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 

@@ -67,14 +67,15 @@ def test_ulysses_comm_sites(ctx_mesh):
 
 
 def test_ring_pallas_fwd_bwd_comm_sites(ctx_mesh):
-    """The backward accounting the round-3 table ignored, pinned: the
-    Pallas ring's hand-written backward rotates FOUR tensors per hop
-    (k, v, dk-partial, dv-partial) through the wrapper layer, so
-    grad-tracing records 2 forward-rule + 4 backward sites. Byte check is
-    double duty: at D=32 on the 128-lane kernel, each site must move the
-    UNPADDED shard (t bytes, not 4t) — rotating kernel-padded tensors
-    would quadruple the wire bytes at this head dim (the pad is applied
-    locally per visit instead; see sequence.py ``_pad_lane``)."""
+    """Backward comm accounting, pinned: the Pallas ring's hand-written
+    Q-SIDE backward rotates THREE head_dim-sized tensors per hop (q, the
+    output cotangent, the travelling dq partial) plus two lane-thin
+    softmax stats (lse's first lane, delta) — 5 backward sites on top of
+    the 2 forward-rule ones. Byte check is double duty: at D=32 on the
+    128-lane kernel every head_dim site must move the UNPADDED shard
+    (t bytes, not 4t) and the two stat rows t/D each — rotating padded
+    tensors or the full lane-broadcast lse would blow this sum up (the
+    pad and broadcast are applied locally per visit instead)."""
     x = jnp.zeros((B, S, H, D), jnp.float32)
     sm = jax.shard_map(
         functools.partial(ring_attention, causal=True, impl="pallas"),
@@ -90,6 +91,7 @@ def test_ring_pallas_fwd_bwd_comm_sites(ctx_mesh):
     with cc.trace_comm() as rec:
         jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(x, x, x)
     t = int(np.prod((B, S // 4, H, D))) * 4
-    assert rec.calls["ppermute[context]"] == 6, dict(rec.calls)
-    assert rec.bytes["ppermute[context]"] == 6 * t, (
-        rec.bytes["ppermute[context]"], t)
+    thin = t // D  # one f32 per (batch, head, position): lse1 or delta
+    assert rec.calls["ppermute[context]"] == 7, dict(rec.calls)
+    assert rec.bytes["ppermute[context]"] == 5 * t + 2 * thin, (
+        rec.bytes["ppermute[context]"], t, thin)
