@@ -51,6 +51,67 @@ def probe() -> None:
     print(f"probe-ok {len(devs)} {devs[0].platform}")
 
 
+# The axon PJRT plugin reaches the TPU through a local relay process that,
+# when healthy, holds half a dozen loopback TCP listeners in the 8000-8299
+# range (observed 8083/8097/8102/8103/8107/8113 while the round-2/3 captures
+# ran; all of them vanish when the tunnel dies — the signature of the round-3
+# and round-4 outages).  Parsing /proc/net/tcp for those listeners is a
+# sub-second, connection-free way to tell "transport down" apart from
+# "backend slow", so a dead tunnel costs ~5 s of the driver's capture budget
+# instead of the 705 s that rounds 3-4 burned on four timed-out backend
+# probes.  BENCH_FORCE_FULL_PROBE=1 skips the check (e.g. if a future relay
+# moves its ports).
+RELAY_PORT_RANGE = (8000, 8299)
+
+
+def relay_listener_ports(
+    paths: tuple[str, ...] = ("/proc/net/tcp", "/proc/net/tcp6"),
+) -> list[int] | None:
+    """Loopback TCP listeners in the relay's port range, from /proc/net/tcp.
+
+    Returns ``None`` when no table could be read at all (foreign netns,
+    non-Linux host) — callers must treat that as "unknown", not "down".
+    """
+    ports: set[int] = set()
+    readable = False
+    for path in paths:
+        try:
+            with open(path) as f:
+                lines = f.readlines()[1:]
+        except OSError:
+            continue
+        readable = True
+        for line in lines:
+            fields = line.split()
+            if len(fields) < 4 or fields[3] != "0A":  # 0A == TCP_LISTEN
+                continue
+            addr, _, port_hex = fields[1].partition(":")
+            # loopback: 127.0.0.1 little-endian, or ::1 / ::ffff:127.0.0.1
+            loopback = addr in (
+                "0100007F",
+                "00000000000000000000000001000000",
+                "0000000000000000FFFF00000100007F",
+            )
+            if not loopback:
+                continue
+            port = int(port_hex, 16)
+            if RELAY_PORT_RANGE[0] <= port <= RELAY_PORT_RANGE[1]:
+                ports.add(port)
+    return sorted(ports) if readable else None
+
+
+def _diagnostic_line(error: str, **extra) -> str:
+    """The single failure-JSON shape the driver parses — defined once."""
+    return json.dumps({
+        "metric": "resnet50_synthetic_imagenet_throughput",
+        "value": None,
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "error": error,
+        **extra,
+    })
+
+
 def run_bench() -> None:
     import jax
 
@@ -196,6 +257,44 @@ def _extract_json_line(out: str) -> dict | None:
 
 def orchestrate() -> int:
     t_start = time.time()
+    if os.environ.get("BENCH_FORCE_FULL_PROBE") != "1":
+        # Retry the snapshot a few times so a relay that is mid-restart at
+        # the exact launch instant doesn't cost the whole round's capture.
+        # Zero listeners is the ONLY fast-fail trigger: a stray non-relay
+        # listener in range merely falls through to the backend probes (the
+        # pre-round-5 behavior), which is the safe direction — a false
+        # "down" would lose a capture, a false "up" only loses time.
+        ports: list[int] | None = None
+        for check in range(3):
+            if check:
+                time.sleep(10)
+            ports = relay_listener_ports()
+            if ports:
+                break
+        if ports == []:
+            # Transport provably down (tables readable, zero listeners):
+            # fail in seconds, not minutes, in the same diagnostic JSON
+            # shape as a timed-out capture.
+            print("[bench] relay pre-probe: no loopback listeners in "
+                  f"{RELAY_PORT_RANGE[0]}-{RELAY_PORT_RANGE[1]}; transport down",
+                  file=sys.stderr)
+            print(_diagnostic_line(
+                "axon relay not listening (no loopback TCP listeners "
+                f"in {RELAY_PORT_RANGE[0]}-{RELAY_PORT_RANGE[1]}, "
+                "3 checks over 20s); TPU transport down — diagnosed "
+                f"in {time.time() - t_start:.0f}s without burning "
+                "the capture budget",
+                preprobe={"relay_ports": [], "checked": "/proc/net/tcp[6]"},
+            ))
+            return 1
+        if ports is None:
+            # /proc/net/tcp unreadable (foreign netns, non-Linux): unknown,
+            # not down — fall through to the backend probes.
+            print("[bench] relay pre-probe: /proc/net/tcp unreadable; "
+                  "falling through to backend probes", file=sys.stderr)
+        else:
+            print(f"[bench] relay pre-probe ok: listeners on {ports}",
+                  file=sys.stderr)
     failures: list[str] = []
     for attempt in range(MAX_ATTEMPTS):
         if attempt:
@@ -217,15 +316,11 @@ def orchestrate() -> int:
         print(f"[bench] run failed (attempt {attempt + 1}/{MAX_ATTEMPTS},"
               f" rc={rc}); backing off", file=sys.stderr)
     # Final failure: one diagnostic JSON line, nonzero exit, no hang.
-    print(json.dumps({
-        "metric": "resnet50_synthetic_imagenet_throughput",
-        "value": None,
-        "unit": "images/sec/chip",
-        "vs_baseline": None,
-        "error": "TPU backend unavailable after "
-                 f"{MAX_ATTEMPTS} attempts in {time.time() - t_start:.0f}s",
-        "attempts": failures[-MAX_ATTEMPTS:],
-    }))
+    print(_diagnostic_line(
+        "TPU backend unavailable after "
+        f"{MAX_ATTEMPTS} attempts in {time.time() - t_start:.0f}s",
+        attempts=failures[-MAX_ATTEMPTS:],
+    ))
     return 1
 
 
