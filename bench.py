@@ -269,7 +269,9 @@ def orchestrate() -> int:
             if check:
                 time.sleep(10)
             ports = relay_listener_ports()
-            if ports:
+            if ports or ports is None:
+                # listeners found, or tables unreadable — the latter is a
+                # permanent condition on this host, not worth 20s of sleeps
                 break
         if ports == []:
             # Transport provably down (tables readable, zero listeners):

@@ -85,6 +85,18 @@ def _record(op: str, axis: Any, tree: Any) -> None:
         rec.record(op, axis, tree)
 
 
+def record_event(op: str, axis: Any, tree: Any = None) -> None:
+    """Record a named NON-collective event into the active ``trace_comm``.
+
+    For decisions that change the comm/compute profile without issuing a
+    collective themselves — e.g. ``ring_attention`` impl="auto" silently
+    taking the ~2x-FLOP XLA path for non-lane-aligned shapes. Shows up in
+    ``CommTrace.calls`` under ``op[axis]`` like any collective, so a test
+    (or a user auditing a trace) sees the degradation instead of guessing
+    from throughput."""
+    _record(op, axis, tree)
+
+
 def axis_size(axis: str) -> int:
     """Size of a mesh axis from inside shard_map (NCCL world-size analogue)."""
     return lax.axis_size(axis)

@@ -185,6 +185,20 @@ def write_records(path: str | Path, columns: Mapping[str, np.ndarray],
         flat = col.reshape(n, -1).view(np.uint8).reshape(n, f.nbytes)
         buf[:, off:off + f.nbytes] = flat
         off += f.nbytes
+    if append:
+        # The format is headerless fixed-size records: appending with a
+        # different field layout would silently interleave two record sizes
+        # and only surface as garbled batches much later. The only check the
+        # format admits is that the existing bytes are a whole number of
+        # *this* layout's records — refuse loudly otherwise.
+        try:
+            existing = os.path.getsize(path)
+        except OSError:
+            existing = 0  # no file yet: append degenerates to a fresh write
+        if existing % rb:
+            raise ValueError(
+                f"append to {path}: existing size {existing} is not a "
+                f"multiple of record_bytes={rb} — field layout mismatch?")
     with open(path, "ab" if append else "wb") as fh:
         fh.write(buf.tobytes())
     return n
@@ -259,6 +273,11 @@ class NativeRecordLoader:
         try:
             self.close()
         except Exception:
+            # Interpreter-shutdown teardown: the ctypes lib handle or its
+            # globals may already be torn down when GC runs us, and raising
+            # from __del__ only prints noise it is too late to act on. The
+            # OS reclaims the mmap/threads either way; an explicit close()
+            # during normal operation still propagates errors.
             pass
 
 
@@ -312,6 +331,8 @@ class PyRecordLoader:
         return _split_batch(self._records[idx], self.fields)
 
     def close(self) -> None:
+        # Interface parity with NativeRecordLoader only: the Python twin
+        # holds no native handle, threads, or mmap — nothing to release.
         pass
 
 

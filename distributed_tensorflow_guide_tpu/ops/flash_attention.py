@@ -638,22 +638,28 @@ _FALLBACKS: dict[tuple, int] = {}
 
 
 def fallback_stats() -> dict[tuple, int]:
-    """(s, d, blk_q, blk_k) -> number of flash->blockwise fallback traces."""
+    """(origin, s, d, blk_q, blk_k) -> number of kernel->XLA fallback traces.
+
+    One registry for every auto-degradation in the package — flash's
+    blockwise fallback AND ring_attention's impl="auto" XLA path — so a
+    profiling audit reads a single surface."""
     return dict(_FALLBACKS)
 
 
-def _note_fallback(s: int, d: int, blk_q: int, blk_k: int) -> None:
+def _note_fallback(s: int, d: int, blk_q: int, blk_k: int, *,
+                   origin: str = "flash_attention",
+                   msg: str | None = None) -> None:
     import logging
 
-    key = (s, d, blk_q, blk_k)
+    key = (origin, s, d, blk_q, blk_k)
     first = key not in _FALLBACKS
     _FALLBACKS[key] = _FALLBACKS.get(key, 0) + 1
     if first:
-        logging.getLogger("dtg.ops.flash").warning(
-            "flash_attention: seq_len %d not a multiple of block (%d, %d); "
-            "falling back to the pure-XLA blockwise path (slower). Pad the "
-            "sequence or adjust blk_q/blk_k.", s, blk_q, blk_k,
-        )
+        logging.getLogger("dtg.ops.flash").warning(msg or (
+            f"flash_attention: seq_len {s} not a multiple of block "
+            f"({blk_q}, {blk_k}); falling back to the pure-XLA blockwise "
+            "path (slower). Pad the sequence or adjust blk_q/blk_k."
+        ))
 
 
 def flash_attention(q, k, v, *, causal: bool = False, blk_q: int = 128,

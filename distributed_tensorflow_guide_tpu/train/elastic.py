@@ -140,13 +140,19 @@ class PreemptionHook:
     def _on_signal(self, signum, frame) -> None:  # signal context: flag only
         self._flagged = True
 
-    def _agreed_flag(self, step: int) -> bool:
+    def _agreed_flag(self, step: int | None = None) -> bool:
+        """Cluster-wide "anyone signalled?". ``step=None`` is a *final*
+        agreement point (loop exit): cadence does not apply, so a SIGTERM
+        landing within the last ``sync_every`` steps is still acted on.
+        The cadence gates single- and multi-host runs identically — a
+        ``sync_every=10`` run reacts at the same step boundaries whether
+        it has 1 process or 16, keeping resume points topology-invariant."""
+        if step is not None and (step + 1) % self.sync_every:
+            return False  # between agreement points nobody acts
         import jax
 
         if jax.process_count() == 1:
             return self._flagged
-        if (step + 1) % self.sync_every:
-            return False  # between agreement points nobody acts
         import numpy as np
         from jax.experimental import multihost_utils
 
@@ -155,17 +161,25 @@ class PreemptionHook:
         )
         return bool(np.asarray(flags).sum() > 0)
 
+    def _save_and_latch(self, done: int) -> None:
+        self.ckpt.save(done, self._loop.state, force=True)
+        self.ckpt.wait()
+        self.preempted_at = done
+        log.warning("preemption signal: saved step %d, stopping", done)
+
     def after_step(self, step: int, metrics) -> None:
         if self.preempted_at is None and self._agreed_flag(step):
-            done = step + 1  # checkpoint labels are completed-step counts
-            self.ckpt.save(done, self._loop.state, force=True)
-            self.ckpt.wait()
-            self.preempted_at = done
-            log.warning("preemption signal: saved step %d, stopping", done)
+            # checkpoint labels are completed-step counts
+            self._save_and_latch(step + 1)
             self._loop.request_stop()
 
     def end(self, step: int) -> None:
-        pass  # handler restoration lives in cleanup (runs on crashes too)
+        # Final agreement drain: a flag raised after the last cadence
+        # boundary (or during the very last steps) must not be dropped on a
+        # normal exit — all hosts reach end() together, so the collective is
+        # safe here. Handler restoration lives in cleanup (runs on crashes).
+        if self.preempted_at is None and self._agreed_flag():
+            self._save_and_latch(step)
 
     def cleanup(self) -> None:
         """Restore original handlers — TrainLoop guarantees this in a

@@ -105,6 +105,40 @@ def test_resume_continues_from_checkpoint(tmp_path):
     ckpt.close()
 
 
+def test_default_layout_pins_hook_driven_saves(tmp_path):
+    """Round-4 advisor: CheckpointHook/PreemptionHook call ckpt.save without
+    layout=, so hook-driven checkpoints of a pipelined model carried no
+    layout pin. default_layout on the Checkpointer closes that hole: every
+    save/restore that doesn't pass layout= inherits it."""
+    layout_a = {"schedule": "interleaved", "P": 2, "v": 2}
+    ckpt = Checkpointer(tmp_path / "ckpt", default_layout=layout_a)
+
+    def step_fn(state, batch):
+        return state.replace(step=state.step + 1), {}
+
+    loop = TrainLoop(
+        step_fn, _state(), iter(lambda: 0, 1),
+        hooks=[StopAtStepHook(2), CheckpointHook(ckpt, every_steps=2)],
+    )
+    final = loop.run()
+    ckpt.wait()
+    assert (tmp_path / "ckpt" / "layout_2.json").exists()
+    # same-layout restore (default applied) succeeds
+    restored = ckpt.restore(final)
+    assert int(restored.step) == 2
+    ckpt.close()
+    # a permuted model's Checkpointer (different default_layout) refuses
+    other = Checkpointer(tmp_path / "ckpt",
+                         default_layout={"schedule": "gpipe", "P": 4, "v": 1})
+    with pytest.raises(ValueError, match="layout mismatch"):
+        other.restore(final)
+    # ...unless the caller explicitly opts out with layout=None (foreign-
+    # topology inspection must stay expressible on a pinned Checkpointer)
+    restored = other.restore(final, layout=None)
+    assert int(restored.step) == 2
+    other.close()
+
+
 def test_sharded_fsdp_roundtrip(tmp_path):
     """Sharding-aware checkpointing (SURVEY.md §5 checkpoint row): an FSDP
     (ZeRO-3) state saves from its shards and restores INTO its shards — the

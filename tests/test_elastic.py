@@ -290,6 +290,56 @@ def test_preemption_sigterm_saves_and_resumes(tmp_path):
     ckpt.close()
 
 
+def test_preemption_sync_every_cadence_and_final_drain(tmp_path):
+    """Round-4 advisor: with sync_every>1 the single-host path reacted every
+    step while multi-host reacted only at agreement points, and a SIGTERM
+    landing after the last agreement point was silently dropped. Now the
+    cadence gates both paths identically and end() runs a final agreement
+    drain, so a late flag still saves."""
+    import os
+    import signal
+
+    from distributed_tensorflow_guide_tpu.train.elastic import PreemptionHook
+    from distributed_tensorflow_guide_tpu.train.loop import TrainLoop
+
+    # SIGTERM during step 3, cadence 50 > TOTAL_STEPS: no agreement point
+    # is ever reached mid-run -> the hook must NOT stop the loop early, and
+    # the end() drain must still save.
+    ckpt = Checkpointer(tmp_path / "late")
+    hook = PreemptionHook(ckpt, sync_every=50)
+
+    def step(state, batch):
+        if int(batch[0]) == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return _step_fn(state, batch)
+
+    loop = TrainLoop(step, _init_state(), _make_data(0),
+                     hooks=[StopAtStepHook(TOTAL_STEPS), hook])
+    loop.run()
+    assert loop.step == TOTAL_STEPS  # cadence held: no mid-run stop
+    assert hook.preempted_at == TOTAL_STEPS  # drain saved at the end
+    assert ckpt.latest_step() == TOTAL_STEPS
+    ckpt.close()
+
+    # cadence-aligned flag: acts at the agreement point, not before
+    ckpt2 = Checkpointer(tmp_path / "aligned")
+    hook2 = PreemptionHook(ckpt2, sync_every=4)
+
+    def step2(state, batch):
+        if int(batch[0]) == 0:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return _step_fn(state, batch)
+
+    loop2 = TrainLoop(step2, _init_state(), _make_data(0),
+                      hooks=[StopAtStepHook(TOTAL_STEPS), hook2])
+    loop2.run()
+    # flagged at step 0 but the first agreement point is after step 3
+    # (done == 4): the loop stops there, not at step 1
+    assert hook2.preempted_at == 4
+    assert loop2.step == 4
+    ckpt2.close()
+
+
 def test_preemption_handler_restored_after_crash(tmp_path):
     """A CRASHED loop must not leave the flag-only handler installed
     process-wide (it would silently swallow the cluster manager's real

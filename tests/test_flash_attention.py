@@ -187,9 +187,9 @@ def test_fallback_is_observable(caplog):
         flash_attention(q, k, v)
     after = fallback_stats()
     assert sum(after.values()) == before + 1
-    assert (96, 64, 128, 128) in after
+    assert ("flash_attention", 96, 64, 128, 128) in after
     # the first fallback for a shape logs a warning
-    if before == 0 or (96, 64, 128, 128) not in dict(
+    if before == 0 or ("flash_attention", 96, 64, 128, 128) not in dict(
         (k_, v_) for k_, v_ in after.items() if v_ > 1
     ):
         assert any("falling back" in r.message for r in caplog.records)
