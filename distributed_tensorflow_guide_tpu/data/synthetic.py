@@ -29,8 +29,13 @@ class SyntheticClassification:
         num_classes: int = 10,
         noise: float = 0.3,
         seed: int = 0,
+        sample_seed: int | None = None,
         dtype=np.float32,
     ):
+        """``seed`` fixes the class prototypes (the *task*); ``sample_seed``
+        fixes the label/noise draws (the *samples*, default ``seed + 1``).
+        A held-out stream for the same task = same seed, different
+        sample_seed — the synthetic analogue of a train/test split."""
         self.batch_size = batch_size
         self.image_shape = image_shape
         self.num_classes = num_classes
@@ -38,7 +43,8 @@ class SyntheticClassification:
         self.dtype = dtype
         proto_rng = np.random.RandomState(seed)
         self.prototypes = proto_rng.randn(num_classes, *image_shape).astype(dtype)
-        self._rng = np.random.RandomState(seed + 1)
+        self._rng = np.random.RandomState(
+            seed + 1 if sample_seed is None else sample_seed)
 
     def __iter__(self) -> Iterator[dict]:
         while True:
@@ -53,8 +59,10 @@ class SyntheticClassification:
         return [next(it) for _ in range(n)]
 
 
-def synthetic_mnist(batch_size: int, seed: int = 0) -> SyntheticClassification:
-    return SyntheticClassification(batch_size, seed=seed)
+def synthetic_mnist(batch_size: int, seed: int = 0,
+                    sample_seed: int | None = None) -> SyntheticClassification:
+    return SyntheticClassification(batch_size, seed=seed,
+                                   sample_seed=sample_seed)
 
 
 class SyntheticCTR:

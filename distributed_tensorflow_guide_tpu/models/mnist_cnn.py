@@ -60,3 +60,18 @@ def make_loss_fn(model: MNISTCNN):
         return loss, {"accuracy": accuracy(logits, batch["label"])}
 
     return loss_fn
+
+
+def make_metric_fn(model: MNISTCNN):
+    """``(params, batch) -> metrics`` for
+    :meth:`DataParallel.make_eval_step` (held-out evaluation: same
+    forward, no gradient, no optimizer)."""
+
+    def metric_fn(params, batch):
+        logits = model.apply({"params": params}, batch["image"])
+        return {
+            "loss": cross_entropy_loss(logits, batch["label"]),
+            "accuracy": accuracy(logits, batch["label"]),
+        }
+
+    return metric_fn

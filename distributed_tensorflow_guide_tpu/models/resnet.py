@@ -113,6 +113,15 @@ ResNet50 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3))
 ResNet18ish = functools.partial(ResNet, stage_sizes=(1, 1, 1, 1))  # test-sized
 
 
+def _ce_and_accuracy(logits, labels):
+    """Softmax cross-entropy + top-1 accuracy — ONE definition shared by
+    the train loss and the eval metrics, so they cannot diverge."""
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+    acc = jnp.mean(jnp.argmax(logits, -1) == labels)
+    return loss, acc
+
+
 def make_loss_fn(model: ResNet, weight_decay: float = 0.0):
     """``(params, model_state, batch) -> (loss, (metrics, new_model_state))``
     for :meth:`DataParallel.make_train_step_with_stats`."""
@@ -124,16 +133,28 @@ def make_loss_fn(model: ResNet, weight_decay: float = 0.0):
             train=True,
             mutable=["batch_stats"],
         )
-        labels = batch["label"]
-        logp = jax.nn.log_softmax(logits)
-        loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+        loss, acc = _ce_and_accuracy(logits, batch["label"])
         if weight_decay:
             loss = loss + 0.5 * weight_decay * sum(
                 jnp.sum(p.astype(jnp.float32) ** 2)
                 for p in jax.tree.leaves(params)
                 if p.ndim > 1  # skip BN scales/biases
             )
-        acc = jnp.mean(jnp.argmax(logits, -1) == labels)
         return loss, ({"accuracy": acc}, new_model_state)
 
     return loss_fn
+
+
+def make_metric_fn(model: ResNet):
+    """``(params, model_state, batch) -> metrics`` for
+    :meth:`DataParallel.make_eval_step_with_stats`: BatchNorm inference
+    mode (running stats, not batch stats), nothing written back."""
+
+    def metric_fn(params, model_state, batch):
+        logits = model.apply(
+            {"params": params, **model_state}, batch["image"], train=False
+        )
+        loss, acc = _ce_and_accuracy(logits, batch["label"])
+        return {"loss": loss, "accuracy": acc}
+
+    return metric_fn

@@ -241,3 +241,22 @@ class DataParallel:
             check_vma=False,
         )
         return jax.jit(sharded)
+
+    def make_eval_step_with_stats(self, metric_fn):
+        """:meth:`make_eval_step` for models with non-trainable state:
+        ``metric_fn(params, model_state, batch) -> {name: scalar}``.
+        Evaluation reads the (already replica-synchronized) running stats
+        — BatchNorm in inference mode — and never writes them back."""
+
+        def sm_eval(state, batch):
+            mets = metric_fn(state.params, state.model_state, batch)
+            return {k: cc.pmean(v, self.axis) for k, v in mets.items()}
+
+        sharded = jax.shard_map(
+            sm_eval,
+            mesh=self.mesh,
+            in_specs=(P(), P(self.axis)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(sharded)

@@ -16,3 +16,7 @@ from distributed_tensorflow_guide_tpu.train.elastic import (  # noqa: F401
     TooManyRestarts,
     run_with_recovery,
 )
+from distributed_tensorflow_guide_tpu.train.evaluation import (  # noqa: F401
+    Evaluator,
+    EvalHook,
+)

@@ -171,7 +171,10 @@ class PreemptionHook:
         if self.preempted_at is None and self._agreed_flag(step):
             # checkpoint labels are completed-step counts
             self._save_and_latch(step + 1)
-            self._loop.request_stop()
+            # "preemption" lets end-phase hooks (EvalHook) skip expensive
+            # final work inside the SIGTERM grace window; the decision is
+            # collective-agreed, so every host stops with the same reason
+            self._loop.request_stop(reason="preemption")
 
     def end(self, step: int) -> None:
         # Final agreement drain: a flag raised after the last cadence

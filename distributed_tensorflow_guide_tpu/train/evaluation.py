@@ -1,0 +1,134 @@
+"""Distributed held-out evaluation — the eval half of the training harness.
+
+The reference reports train-batch loss only: its examples print the running
+loss from ``sess.run`` and nothing else, and the harness it leans on —
+``MonitoredTrainingSession`` (tensorflow/python/training/monitored_session.py:428)
+with ``SummarySaverHook`` (basic_session_run_hooks.py:793) — summarizes
+*training* tensors. A framework claiming that harness role needs the other
+half: periodic evaluation on data the optimizer never saw.
+
+TPU-native shape: evaluation is the SAME SPMD program structure as training
+minus the gradient — a compiled no-grad step over sharded batches whose
+metrics are ``pmean``-ed across the mesh (``DataParallel.make_eval_step``
+and ``make_eval_step_with_stats`` build these). The harness here drives one
+full pass over a finite held-out stream and averages per-batch metrics on
+the host. Every process runs the collective eval step (it must — the pmean
+is a collective); only the chief *reports*.
+
+Parity contract (SURVEY.md §4 rule 3): a dp-8 evaluation equals the
+single-device evaluation of the same data — pinned by
+tests/test_evaluation.py.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterable
+
+from distributed_tensorflow_guide_tpu.core.dist import is_chief
+from distributed_tensorflow_guide_tpu.train.hooks import BaseHook
+
+log = logging.getLogger("dtg.train")
+
+
+class Evaluator:
+    """One full pass of a compiled eval step over a held-out stream.
+
+    ``eval_step(state, batch) -> {name: scalar}`` — a compiled collective
+    step whose metrics are already aggregated across devices (e.g.
+    ``DataParallel.make_eval_step``); ``state`` is passed through untouched.
+
+    ``make_data() -> finite iterable`` of already-sharded batches; called
+    fresh per :meth:`run` so every evaluation sees the whole held-out set
+    from the start (the analogue of re-initializing an eval input pipeline).
+    Equal-sized batches make mean-of-batch-means exact; a ragged final
+    batch would bias the mean, so build the stream with a batch size that
+    divides the eval set (the native loader drops the remainder).
+
+    ``max_batches`` bounds a pass (for smoke/CI runs on giant sets).
+    """
+
+    def __init__(self, eval_step: Callable[[Any, Any], dict],
+                 make_data: Callable[[], Iterable], *,
+                 max_batches: int | None = None):
+        self.eval_step = eval_step
+        self.make_data = make_data
+        self.max_batches = max_batches
+
+    def run(self, state: Any) -> dict[str, float]:
+        """Evaluate ``state``; returns mean metrics plus ``eval_batches``."""
+        sums: dict[str, float] = {}
+        n = 0
+        for batch in self.make_data():
+            if self.max_batches is not None and n >= self.max_batches:
+                break
+            mets = self.eval_step(state, batch)
+            for k, v in mets.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            n += 1
+        if n == 0:
+            raise ValueError(
+                "evaluation stream yielded no batches — make_data() must "
+                "return a non-empty finite iterable")
+        out = {k: v / n for k, v in sums.items()}
+        out["eval_batches"] = float(n)
+        return out
+
+
+class EvalHook(BaseHook):
+    """Periodic + end-of-run held-out evaluation inside the train loop.
+
+    Runs the evaluator every ``every_steps`` completed steps and once at
+    ``end`` (skipped if the final step already evaluated, or if the loop
+    stopped for preemption — a multi-batch eval pass must not eat the
+    SIGTERM grace window the preemption save needs). All processes execute
+    the collective eval pass; the chief logs
+    ``eval[<name>] step=N metric=...``. Results are kept on the hook:
+    ``latest`` (most recent metrics) and ``history`` ([(step, metrics)])
+    for tests and callers.
+
+    With :class:`~distributed_tensorflow_guide_tpu.train.elastic.
+    PreemptionHook` in the same loop, list the PreemptionHook FIRST so its
+    end-phase drain saves before any end-of-run evaluation runs.
+    """
+
+    def __init__(self, evaluator: Evaluator, every_steps: int = 0, *,
+                 name: str = "eval"):
+        if every_steps < 0:
+            raise ValueError("every_steps must be >= 0 (0 = end-of-run only)")
+        self.evaluator = evaluator
+        self.every_steps = every_steps
+        self.name = name
+        self.latest: dict[str, float] | None = None
+        self.history: list[tuple[int, dict[str, float]]] = []
+        self._loop = None
+        self._last_eval_step = -1
+
+    def begin(self, loop) -> None:
+        self._loop = loop
+        self.latest = None
+        self.history = []
+        self._last_eval_step = -1
+
+    def _evaluate(self, done: int) -> None:
+        mets = self.evaluator.run(self._loop.state)
+        self.latest = mets
+        self.history.append((done, mets))
+        self._last_eval_step = done
+        if is_chief():
+            body = " ".join(
+                f"{k}={v:.4f}" for k, v in mets.items() if k != "eval_batches"
+            )
+            log.info("eval[%s] step=%d %s (%d batches)", self.name, done,
+                     body, int(mets["eval_batches"]))
+
+    def after_step(self, step: int, metrics) -> None:
+        done = step + 1  # completed-step count, matching checkpoint labels
+        if self.every_steps and done % self.every_steps == 0:
+            self._evaluate(done)
+
+    def end(self, step: int) -> None:
+        if getattr(self._loop, "stop_reason", None) == "preemption":
+            return  # grace window belongs to the preemption checkpoint
+        if step != self._last_eval_step:
+            self._evaluate(step)

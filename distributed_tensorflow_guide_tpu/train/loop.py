@@ -46,9 +46,19 @@ class TrainLoop:
         self.hooks = list(hooks)
         self.step = start_step
         self._stop = False
+        self.stop_reason: str | None = None
 
-    def request_stop(self) -> None:
-        """Hook-callable stop signal (``sess.should_stop()`` equivalent)."""
+    def request_stop(self, reason: str = "hook") -> None:
+        """Hook-callable stop signal (``sess.should_stop()`` equivalent).
+
+        ``reason`` lets end-phase hooks adapt: PreemptionHook passes
+        "preemption" so e.g. EvalHook skips its final full eval pass
+        inside the SIGTERM grace window. Must be set identically on every
+        host (the callers' stop decisions are collective-agreed) — end
+        hooks run collectives, and a host-divergent reason would deadlock
+        them. First stop wins; later calls don't overwrite the reason."""
+        if not self._stop:
+            self.stop_reason = reason
         self._stop = True
 
     @property

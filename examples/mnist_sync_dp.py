@@ -27,6 +27,11 @@ def main() -> None:
     ap.add_argument("--global-batch", type=int, default=256)
     ap.add_argument("--lr", type=float, default=0.05)
     ap.add_argument("--log-every", type=int, default=20)
+    ap.add_argument("--eval-every", type=int, default=0,
+                    help="held-out evaluation every N steps (always once at "
+                         "the end); 0 = end-of-run only")
+    ap.add_argument("--eval-batches", type=int, default=8,
+                    help="batches per evaluation pass")
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument("--fake-devices", type=int, default=0,
                     help="force N virtual CPU devices (testing without a pod)")
@@ -58,11 +63,17 @@ def main() -> None:
         build_mesh,
     )
     from distributed_tensorflow_guide_tpu.data.synthetic import synthetic_mnist
-    from distributed_tensorflow_guide_tpu.models.mnist_cnn import MNISTCNN, make_loss_fn
+    from distributed_tensorflow_guide_tpu.models.mnist_cnn import (
+        MNISTCNN,
+        make_loss_fn,
+        make_metric_fn,
+    )
     from distributed_tensorflow_guide_tpu.parallel.data_parallel import DataParallel
     from distributed_tensorflow_guide_tpu.train import (
         CheckpointHook,
         Checkpointer,
+        EvalHook,
+        Evaluator,
         LoggingHook,
         StepCounterHook,
         StopAtStepHook,
@@ -107,10 +118,60 @@ def main() -> None:
         print(f"native loader: {loader.num_records} records from {rec} "
               f"({type(loader).__name__})")
         data = (dp.shard_batch(decode_mnist_batch(b)) for b in loader)
+
+        make_eval_data = None
+        if args.eval_batches > 0:
+            # data the optimizer never sees, streamed in-order (shuffle
+            # off — eval order must not perturb results). Materialized
+            # ONCE at setup: every eval pass sees the identical batches,
+            # and a missing/too-small t10k split surfaces here as a
+            # notice, not as a crash at the end-of-run evaluation.
+            try:
+                eval_rec = import_mnist(args.data,
+                                        Path(args.data) / "records",
+                                        split="test")
+                eval_loader = open_record_loader(
+                    eval_rec, MNIST_FIELDS, args.global_batch, shuffle=False)
+            except (FileNotFoundError, ValueError) as e:
+                print(f"held-out evaluation disabled: {e}")
+            else:
+                n = min(args.eval_batches, eval_loader.batches_per_epoch)
+                it = iter(eval_loader)
+                eval_batches = [
+                    dp.shard_batch(decode_mnist_batch(next(it)))
+                    for _ in range(n)
+                ]
+                loader_close = getattr(eval_loader, "close", None)
+                if loader_close:
+                    loader_close()
+
+                def make_eval_data():
+                    return eval_batches
     else:
         data = (dp.shard_batch(b) for b in synthetic_mnist(args.global_batch))
 
+        make_eval_data = None
+        if args.eval_batches > 0:
+            # held-out synthetic stream: same class prototypes (same
+            # task), disjoint sample draws — the synthetic train/test split
+            eval_batches = [
+                dp.shard_batch(b)
+                for b in synthetic_mnist(args.global_batch,
+                                         sample_seed=10_001).take(
+                    args.eval_batches)
+            ]
+
+            def make_eval_data():
+                return eval_batches
+
+    eval_hook = None
     hooks = [StopAtStepHook(args.steps)]
+    if make_eval_data is not None:
+        evaluator = Evaluator(dp.make_eval_step(make_metric_fn(model)),
+                              make_eval_data)
+        eval_hook = EvalHook(evaluator, every_steps=args.eval_every,
+                             name="mnist")
+        hooks.append(eval_hook)
     if args.log_every:  # 0 = silent (smoke tests)
         hooks += [
             LoggingHook(args.log_every),
@@ -128,8 +189,12 @@ def main() -> None:
 
     loop = TrainLoop(step, state, data, hooks=hooks, start_step=start_step)
     loop.run()
+    tail = ""
+    if eval_hook is not None and eval_hook.latest:
+        tail = (f"; held-out accuracy {eval_hook.latest['accuracy']:.4f} "
+                f"(loss {eval_hook.latest['loss']:.4f})")
     print(f"done: {loop.step} steps on {n_dev} device(s), mesh axes "
-          f"{axis_sizes(mesh)}")
+          f"{axis_sizes(mesh)}{tail}")
 
 
 if __name__ == "__main__":

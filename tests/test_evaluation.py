@@ -1,0 +1,177 @@
+"""Distributed held-out evaluation (train/evaluation.py).
+
+The parity rule (SURVEY.md §4 rule 3) applied to the eval half of the
+harness: a dp-8 evaluation must equal the single-device evaluation of the
+same data. Plus the Evaluator/EvalHook mechanics: full-pass averaging,
+cadence, end-of-run dedupe.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax.training import train_state
+
+from distributed_tensorflow_guide_tpu.data.synthetic import synthetic_mnist
+from distributed_tensorflow_guide_tpu.models.mnist_cnn import (
+    MNISTCNN,
+    make_loss_fn,
+    make_metric_fn,
+)
+from distributed_tensorflow_guide_tpu.parallel.data_parallel import DataParallel
+from distributed_tensorflow_guide_tpu.train import (
+    EvalHook,
+    Evaluator,
+    StopAtStepHook,
+    TrainLoop,
+)
+
+
+def _state(dp=None):
+    model = MNISTCNN()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))[
+        "params"]
+    st = train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.05)
+    )
+    return (dp.replicate(st) if dp else st), model
+
+
+def test_evaluator_full_pass_mean():
+    calls = []
+
+    def eval_step(state, batch):
+        calls.append(batch)
+        return {"loss": jnp.float32(batch), "acc": jnp.float32(batch * 10)}
+
+    ev = Evaluator(eval_step, lambda: [1.0, 2.0, 3.0])
+    out = ev.run(state=None)
+    assert out == {"loss": 2.0, "acc": 20.0, "eval_batches": 3.0}
+    assert len(calls) == 3
+    # every run() re-reads the stream from the start
+    ev.run(state=None)
+    assert len(calls) == 6
+    # max_batches bounds a pass
+    out = Evaluator(eval_step, lambda: [1.0, 2.0, 3.0], max_batches=2).run(None)
+    assert out["eval_batches"] == 2.0
+    with pytest.raises(ValueError, match="no batches"):
+        Evaluator(eval_step, lambda: []).run(None)
+
+
+def test_dp8_eval_matches_single_device(mesh8):
+    """The parity contract: pmean-of-per-shard-means over 8 equal shards ==
+    the plain mean a single device computes on the full batch."""
+    dp = DataParallel(mesh8)
+    state, model = _state(dp)
+    metric_fn = make_metric_fn(model)
+    eval_step = dp.make_eval_step(metric_fn)
+
+    batches = [b for b in synthetic_mnist(64, sample_seed=7).take(3)]
+    dist = Evaluator(
+        eval_step, lambda: [dp.shard_batch(b) for b in batches]
+    ).run(state)
+
+    # single-device oracle: the raw metric_fn on the full (unsharded) batch
+    params = jax.tree.map(np.asarray, jax.device_get(state.params))
+    single = {"loss": 0.0, "accuracy": 0.0}
+    for b in batches:
+        mets = metric_fn(params, jax.tree.map(jnp.asarray, b))
+        for k in single:
+            single[k] += float(mets[k]) / len(batches)
+
+    assert dist["eval_batches"] == 3.0
+    np.testing.assert_allclose(dist["loss"], single["loss"], rtol=1e-5)
+    np.testing.assert_allclose(dist["accuracy"], single["accuracy"],
+                               rtol=1e-5)
+
+
+def test_eval_hook_cadence_and_end(mesh8):
+    """every_steps cadence + exactly one end-of-run eval (deduped when the
+    final step already evaluated), on a real train loop."""
+    dp = DataParallel(mesh8)
+    state, model = _state(dp)
+    step = dp.make_train_step(make_loss_fn(model))
+    ev = Evaluator(
+        dp.make_eval_step(make_metric_fn(model)),
+        lambda: [dp.shard_batch(b)
+                 for b in synthetic_mnist(64, sample_seed=9).take(2)],
+    )
+
+    hook = EvalHook(ev, every_steps=2)
+    data = (dp.shard_batch(b) for b in synthetic_mnist(64))
+    TrainLoop(step, state, data, hooks=[StopAtStepHook(5), hook]).run()
+    assert [s for s, _ in hook.history] == [2, 4, 5]
+    assert hook.latest is hook.history[-1][1]
+    assert set(hook.latest) == {"loss", "accuracy", "eval_batches"}
+
+    # cadence dividing the run length: the end() eval is NOT duplicated
+    hook2 = EvalHook(ev, every_steps=2)
+    state2, _ = _state(dp)
+    data2 = (dp.shard_batch(b) for b in synthetic_mnist(64))
+    TrainLoop(step, state2, data2, hooks=[StopAtStepHook(4), hook2]).run()
+    assert [s for s, _ in hook2.history] == [2, 4]
+
+    # every_steps=0: end-of-run only
+    hook3 = EvalHook(ev, every_steps=0)
+    state3, _ = _state(dp)
+    data3 = (dp.shard_batch(b) for b in synthetic_mnist(64))
+    TrainLoop(step, state3, data3, hooks=[StopAtStepHook(3), hook3]).run()
+    assert [s for s, _ in hook3.history] == [3]
+
+
+def test_eval_hook_skips_final_pass_on_preemption(mesh8, tmp_path):
+    """A preemption stop must not spend the SIGTERM grace window on a
+    multi-batch eval pass: EvalHook.end no-ops when the loop stopped with
+    reason='preemption' (the PreemptionHook save wins the window)."""
+    import os
+    import signal
+
+    from distributed_tensorflow_guide_tpu.train import (
+        Checkpointer,
+        PreemptionHook,
+    )
+
+    dp = DataParallel(mesh8)
+    state, model = _state(dp)
+
+    train_step = dp.make_train_step(make_loss_fn(model))
+
+    def step(st, batch):
+        os.kill(os.getpid(), signal.SIGTERM)  # deferred to the flag
+        return train_step(st, batch)
+
+    ckpt = Checkpointer(tmp_path / "pre")
+    ev = Evaluator(
+        dp.make_eval_step(make_metric_fn(model)),
+        lambda: [dp.shard_batch(b) for b in synthetic_mnist(64).take(1)],
+    )
+    hook = EvalHook(ev, every_steps=0)
+    pre = PreemptionHook(ckpt)
+    data = (dp.shard_batch(b) for b in synthetic_mnist(64))
+    loop = TrainLoop(step, state, data,
+                     hooks=[StopAtStepHook(10), pre, hook])
+    loop.run()
+    assert pre.preempted_at == 1  # stopped after the first step
+    assert loop.stop_reason == "preemption"
+    assert hook.history == []  # the final eval pass was skipped
+    ckpt.close()
+
+
+def test_eval_during_training_improves(mesh8):
+    """End-to-end: held-out metrics actually improve as training fits the
+    shared-prototype task (same task, disjoint sample draws)."""
+    dp = DataParallel(mesh8)
+    state, model = _state(dp)
+    step = dp.make_train_step(make_loss_fn(model))
+    ev = Evaluator(
+        dp.make_eval_step(make_metric_fn(model)),
+        lambda: [dp.shard_batch(b)
+                 for b in synthetic_mnist(64, sample_seed=11).take(2)],
+    )
+    hook = EvalHook(ev, every_steps=10)
+    data = (dp.shard_batch(b) for b in synthetic_mnist(64))
+    TrainLoop(step, state, data, hooks=[StopAtStepHook(30), hook]).run()
+    first, last = hook.history[0][1], hook.history[-1][1]
+    assert last["loss"] < first["loss"]
+    assert last["accuracy"] >= first["accuracy"]

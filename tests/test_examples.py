@@ -44,6 +44,23 @@ def test_non_distributed_control_example():
     assert "platform: cpu" in r.stdout, r.stdout
 
 
+def test_resnet_imagenet_dp_example():
+    """Judged config 2 as an example script: DP + BN-stats sync + held-out
+    evaluation (train/evaluation.py), smoke-sized."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "resnet_imagenet_dp.py"),
+         "--fake-devices", "8", "--steps", "6", "--model", "small",
+         "--image-size", "32", "--global-batch", "32", "--num-classes", "10",
+         "--eval-batches", "2", "--log-every", "0"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "done: 6 steps" in r.stdout, r.stdout
+    assert "held-out accuracy" in r.stdout, r.stdout
+
+
 def test_fsdp_zero3_example():
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)

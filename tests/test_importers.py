@@ -78,10 +78,14 @@ def mnist_dir(tmp_path):
     rng = np.random.RandomState(7)
     images = rng.randint(0, 256, (64, 28, 28)).astype(np.uint8)
     labels = rng.randint(0, 10, (64,)).astype(np.uint8)
+    t10k_images = rng.randint(0, 256, (64, 28, 28)).astype(np.uint8)
+    t10k_labels = rng.randint(0, 10, (64,)).astype(np.uint8)
     d = tmp_path / "mnist"
     d.mkdir()
     for stem, arr in [("train-images-idx3-ubyte", images),
-                      ("train-labels-idx1-ubyte", labels)]:
+                      ("train-labels-idx1-ubyte", labels),
+                      ("t10k-images-idx3-ubyte", t10k_images),
+                      ("t10k-labels-idx1-ubyte", t10k_labels)]:
         tmp = d / stem
         write_idx(tmp, arr)
         (d / f"{stem}.gz").write_bytes(gzip.compress(tmp.read_bytes()))
@@ -163,3 +167,34 @@ def test_mnist_example_trains_from_imported_records(mnist_dir):
     assert r.returncode == 0, r.stdout + r.stderr
     assert "native loader: 64 records" in r.stdout, r.stdout
     assert "done: 4 steps" in r.stdout
+    # end-of-run evaluation on the imported t10k split (train/evaluation.py)
+    assert "held-out accuracy" in r.stdout, r.stdout
+
+
+def test_mnist_example_skips_eval_without_test_split(tmp_path):
+    """A train-only download (no t10k files) must disable held-out eval
+    with a notice, not crash at the end-of-run evaluation."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    rng = np.random.RandomState(3)
+    d = tmp_path / "mnist"
+    d.mkdir()
+    write_idx(d / "train-images-idx3-ubyte",
+              rng.randint(0, 256, (64, 28, 28)).astype(np.uint8))
+    write_idx(d / "train-labels-idx1-ubyte",
+              rng.randint(0, 10, (64,)).astype(np.uint8))
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, str(repo / "examples" / "mnist_sync_dp.py"),
+         "--steps", "2", "--global-batch", "32", "--fake-devices", "4",
+         "--log-every", "0", "--data", str(d)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=repo,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "evaluation disabled" in r.stdout, r.stdout
+    assert "held-out accuracy" not in r.stdout
