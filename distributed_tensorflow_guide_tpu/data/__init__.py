@@ -20,3 +20,9 @@ from distributed_tensorflow_guide_tpu.data.synthetic import (  # noqa: F401
     synthetic_imagenet,
     synthetic_mnist,
 )
+from distributed_tensorflow_guide_tpu.data.tokenizer import (  # noqa: F401
+    ByteBPETokenizer,
+    ByteTokenizer,
+    import_text,
+    text_fields,
+)

@@ -1,0 +1,212 @@
+"""Byte-level tokenizers + the text → token-record pipeline.
+
+The reference's input story is TF's compiled input machinery over MNIST
+(SURVEY.md §2b row 3); its LM-era configs here (GPT-2 config 5, BERT
+config 3) need the text equivalent: corpus in, fixed-length token records
+out, streamed by the same native loader that feeds images. This module is
+the host-side text tier:
+
+* :class:`ByteTokenizer` — the 256-byte vocabulary (+EOS). Zero training,
+  perfectly lossless; the byte-vocab baseline used by byte-level LMs.
+* :class:`ByteBPETokenizer` — GPT-2-style byte-level BPE: base vocab is
+  the 256 bytes, merges are learned greedily from corpus pair counts, so
+  ANY input roundtrips exactly (no <unk> — unknown text degrades to raw
+  bytes, never fails). Pre-tokenization attaches one leading space to each
+  word (GPT-2's convention, simplified: no regex category classes) and
+  merges never cross pre-token boundaries.
+* :func:`import_text` — corpus file → packed fixed-length records through
+  :func:`~distributed_tensorflow_guide_tpu.data.native_loader.write_records`
+  in bounded-memory chunks, ready for the C++ mmap/shuffle/prefetch loader.
+
+TPU-first consequence: tokenization is a one-time host-side import, never
+per-step work — steps stream mmap'd int32 records, exactly like the image
+path, so the chip never waits on Python string handling.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from distributed_tensorflow_guide_tpu.data.native_loader import (
+    Field,
+    make_fields,
+    write_records,
+)
+
+# pre-tokens: a word with its leading space attached (" hello"), runs of
+# other whitespace, or leading-of-text words. Byte-level: applied to the
+# raw utf-8 bytes, so no unicode table is needed at encode time.
+_PRETOKEN = re.compile(rb" ?[^\s]+|\s+")
+
+
+class ByteTokenizer:
+    """Identity byte vocabulary: id i == byte i, plus one EOS id (256)."""
+
+    def __init__(self):
+        self.vocab_size = 257
+        self.eos_id = 256
+
+    def encode(self, text: str | bytes) -> list[int]:
+        data = text.encode("utf-8") if isinstance(text, str) else bytes(text)
+        return list(data)
+
+    def decode(self, ids: Iterable[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class ByteBPETokenizer:
+    """Byte-level BPE: 256 byte ids + learned merges (+EOS as the last id).
+
+    ``merges[k] = (a, b)`` creates token ``256 + k`` from adjacent tokens
+    (a, b); lower k = higher priority at encode time, exactly the ranking
+    produced by greedy frequency training. Losslessness is structural:
+    every token decodes to a fixed byte string and every byte is a token,
+    so decode(encode(x)) == x for any x.
+    """
+
+    def __init__(self, merges: Sequence[tuple[int, int]] = ()):
+        self.merges = [tuple(m) for m in merges]
+        self._rank = {m: k for k, m in enumerate(self.merges)}
+        # id -> bytes expansion table (merge ids reference only earlier ids,
+        # so one forward pass materializes it)
+        self._bytes: list[bytes] = [bytes([b]) for b in range(256)]
+        for a, b in self.merges:
+            self._bytes.append(self._bytes[a] + self._bytes[b])
+        self.eos_id = 256 + len(self.merges)
+        self.vocab_size = self.eos_id + 1
+        self._word_cache: dict[bytes, tuple[int, ...]] = {}
+
+    # -- training -----------------------------------------------------------
+
+    @classmethod
+    def train(cls, text: str | bytes, vocab_size: int,
+              min_pair_count: int = 2) -> "ByteBPETokenizer":
+        """Greedy BPE over pre-token frequencies (Sennrich et al. 2016,
+        byte flavor). ``vocab_size`` counts bytes + merges + EOS; training
+        stops early when no adjacent pair reaches ``min_pair_count``."""
+        if vocab_size < 258:
+            raise ValueError("vocab_size must be >= 258 (256 bytes + >=1 "
+                             f"merge + EOS), got {vocab_size}")
+        data = text.encode("utf-8") if isinstance(text, str) else bytes(text)
+        # word -> frequency; BPE statistics over types, not tokens
+        freqs: dict[bytes, int] = {}
+        for m in _PRETOKEN.finditer(data):
+            w = m.group()
+            freqs[w] = freqs.get(w, 0) + 1
+        words = [(list(w), f) for w, f in freqs.items()]
+        merges: list[tuple[int, int]] = []
+        n_merges = vocab_size - 257  # minus bytes and EOS
+        for next_id in range(256, 256 + n_merges):
+            counts: dict[tuple[int, int], int] = {}
+            for seq, f in words:
+                for pair in zip(seq, seq[1:]):
+                    counts[pair] = counts.get(pair, 0) + f
+            if not counts:
+                break
+            # deterministic tie-break: max count, then smallest pair ids
+            best, n = min(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+            if n < min_pair_count:
+                break
+            merges.append(best)
+            a, b = best
+            for seq, _ in words:
+                i = 0
+                while i < len(seq) - 1:
+                    if seq[i] == a and seq[i + 1] == b:
+                        seq[i:i + 2] = [next_id]
+                    else:
+                        i += 1
+        return cls(merges)
+
+    # -- encode / decode ----------------------------------------------------
+
+    def _encode_word(self, word: bytes) -> tuple[int, ...]:
+        cached = self._word_cache.get(word)
+        if cached is not None:
+            return cached
+        seq = list(word)
+        while len(seq) > 1:
+            ranked = [
+                (self._rank[p], i)
+                for i, p in enumerate(zip(seq, seq[1:]))
+                if p in self._rank
+            ]
+            if not ranked:
+                break
+            rank, i = min(ranked)
+            seq[i:i + 2] = [256 + rank]
+        out = tuple(seq)
+        if len(self._word_cache) < 1 << 20:  # bounded (corpora repeat words)
+            self._word_cache[word] = out
+        return out
+
+    def encode(self, text: str | bytes) -> list[int]:
+        data = text.encode("utf-8") if isinstance(text, str) else bytes(text)
+        out: list[int] = []
+        for m in _PRETOKEN.finditer(data):
+            out.extend(self._encode_word(m.group()))
+        return out
+
+    def decode(self, ids: Iterable[int]) -> str:
+        return b"".join(
+            self._bytes[i] for i in ids if 0 <= i < len(self._bytes)
+        ).decode("utf-8", errors="replace")
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps({
+            "format": "dtg-byte-bpe-v1",
+            "merges": [list(m) for m in self.merges],
+        }))
+
+    @classmethod
+    def load(cls, path: str | Path) -> "ByteBPETokenizer":
+        spec = json.loads(Path(path).read_text())
+        if spec.get("format") != "dtg-byte-bpe-v1":
+            raise ValueError(f"{path}: not a dtg-byte-bpe-v1 vocab file")
+        return cls([tuple(m) for m in spec["merges"]])
+
+
+# -- corpus -> fixed-length token records ------------------------------------
+
+
+def text_fields(seq_len: int) -> list[Field]:
+    """The record layout LM configs stream: one int32 token row per record.
+    Models shift internally (targets = tokens[:, 1:]), so a record is
+    exactly the training window."""
+    return make_fields({"tokens": (np.int32, (seq_len,))})
+
+
+def import_text(corpus: str | Path, out: str | Path, tokenizer,
+                seq_len: int, *, chunk_records: int = 4096) -> int:
+    """Tokenize ``corpus`` and pack into ``out`` as fixed-length records.
+
+    The token stream is document text + EOS, sliced into back-to-back
+    ``seq_len`` windows (remainder dropped — records are fixed-size by
+    format). Written through ``write_records(append=True)`` in
+    ``chunk_records`` chunks so corpus size is bounded by the token array,
+    not a full record buffer. Returns the number of records written.
+    """
+    corpus, out = Path(corpus), Path(out)
+    ids = tokenizer.encode(corpus.read_bytes())
+    ids.append(tokenizer.eos_id)
+    n_records = len(ids) // seq_len
+    if n_records == 0:
+        raise ValueError(
+            f"{corpus}: only {len(ids)} tokens — need at least seq_len="
+            f"{seq_len} for one record")
+    fields = text_fields(seq_len)
+    arr = np.asarray(ids[:n_records * seq_len], np.int32).reshape(
+        n_records, seq_len)
+    out.unlink(missing_ok=True)  # append below must start clean
+    for lo in range(0, n_records, chunk_records):
+        write_records(out, {"tokens": arr[lo:lo + chunk_records]}, fields,
+                      append=lo > 0)
+    return n_records

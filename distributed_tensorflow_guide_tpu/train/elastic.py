@@ -183,6 +183,10 @@ class PreemptionHook:
         # safe here. Handler restoration lives in cleanup (runs on crashes).
         if self.preempted_at is None and self._agreed_flag():
             self._save_and_latch(step)
+            # retag the stop so later end-phase hooks (EvalHook — list it
+            # AFTER this hook) skip grace-window-eating work; the drain
+            # decision is collective-agreed, so the retag is uniform
+            self._loop.stop_reason = "preemption"
 
     def cleanup(self) -> None:
         """Restore original handlers — TrainLoop guarantees this in a

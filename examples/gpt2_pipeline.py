@@ -42,6 +42,14 @@ def main() -> None:
     ap.add_argument("--model-parallel", type=int, default=1,
                     help="TP degree inside each stage (Megatron f/g; the "
                          "LM head goes vocab-parallel) — 3D dp x tp x pp")
+    ap.add_argument("--data", default=None, metavar="CORPUS",
+                    help="text file to train on: byte-level BPE is trained "
+                         "(or loaded from CORPUS.vocab.json), the corpus is "
+                         "packed into fixed-length token records, and the "
+                         "native mmap/shuffle/prefetch loader streams "
+                         "batches. Default: random tokens.")
+    ap.add_argument("--bpe-vocab", type=int, default=1024,
+                    help="target BPE vocab size when training a tokenizer")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -72,8 +80,47 @@ def main() -> None:
     mesh = build_mesh(MeshSpec(data=-1, pipe=args.pipe,
                                model=args.model_parallel))
     sizes = axis_sizes(mesh)
+
+    tokenizer = None
+    if args.data:
+        # real text: one-time host-side import — train/load the byte-level
+        # BPE, pack the corpus into seq_len token records, stream via the
+        # native loader. Tokenization never touches the training hot path.
+        from distributed_tensorflow_guide_tpu.data.tokenizer import (
+            ByteBPETokenizer,
+        )
+
+        vocab_file = Path(args.data).with_suffix(".vocab.json")
+        if vocab_file.exists():
+            tokenizer = ByteBPETokenizer.load(vocab_file)
+            print(f"loaded BPE vocab: {vocab_file} "
+                  f"({tokenizer.vocab_size} tokens)")
+        else:
+            tokenizer = ByteBPETokenizer.train(
+                Path(args.data).read_bytes(), vocab_size=args.bpe_vocab)
+            tokenizer.save(vocab_file)
+            print(f"trained BPE vocab: {len(tokenizer.merges)} merges -> "
+                  f"{vocab_file}")
+        # model vocab: tokenizer's, padded up to a lane multiple (MXU
+        # tiling + vocab-parallel divisibility under --model-parallel);
+        # an explicit larger --vocab is respected (headroom keeps later
+        # checkpoints shape-compatible with a regrown vocab)
+        padded = -(-tokenizer.vocab_size // 128) * 128
+        if args.vocab > padded:
+            print(f"vocab: keeping --vocab {args.vocab} "
+                  f"(tokenizer needs {padded})")
+        else:
+            if args.vocab != ap.get_default("vocab"):
+                print(f"vocab: --vocab {args.vocab} too small for the "
+                      f"tokenizer; using {padded}")
+            args.vocab = padded
+
     if args.full_gpt2:
         cfg = gpt2_124m(remat=True)
+        if tokenizer is not None and tokenizer.vocab_size > cfg.vocab_size:
+            raise SystemExit(
+                f"--full-gpt2 pins vocab {cfg.vocab_size}; the trained "
+                f"tokenizer needs {tokenizer.vocab_size} — lower --bpe-vocab")
     else:
         cfg = TransformerConfig(
             vocab_size=args.vocab, num_layers=args.layers,
@@ -91,10 +138,36 @@ def main() -> None:
     step = pp.make_train_step(tx, params)
 
     per_shard = args.microbatches * args.microbatch_size
-    rng = np.random.RandomState(0)
-    tokens_fixed = rng.randint(
-        0, cfg.vocab_size, (per_shard * sizes["data"], cfg.max_len)
-    ).astype(np.int32)
+    global_batch = per_shard * sizes["data"]
+    if args.data:
+        from distributed_tensorflow_guide_tpu.data.tokenizer import (
+            import_text,
+            text_fields,
+        )
+        from distributed_tensorflow_guide_tpu.data.native_loader import (
+            open_record_loader,
+        )
+
+        rec = Path(args.data).with_suffix(f".s{cfg.max_len}.records")
+        # one-time import, mtime-keyed like _build_lib: re-tokenize only
+        # when the corpus or vocab changed since the records were packed
+        src_mtime = max(Path(args.data).stat().st_mtime,
+                        vocab_file.stat().st_mtime)
+        if rec.exists() and rec.stat().st_mtime >= src_mtime:
+            n_rec = rec.stat().st_size // (cfg.max_len * 4)
+        else:
+            n_rec = import_text(args.data, rec, tokenizer, cfg.max_len)
+        loader = open_record_loader(rec, text_fields(cfg.max_len),
+                                    global_batch)
+        print(f"native loader: {n_rec} records x {cfg.max_len} tokens "
+              f"from {rec} ({type(loader).__name__})")
+        batches = (b["tokens"] for b in loader)
+    else:
+        rng = np.random.RandomState(0)
+        tokens_fixed = rng.randint(
+            0, cfg.vocab_size, (global_batch, cfg.max_len)
+        ).astype(np.int32)
+        batches = iter(lambda: tokens_fixed, None)
     if args.virtual_chunks > 1:
         # interleaved: bubble from the actual schedule, in full-stage units
         # (each tick costs 1/v of a stage)
@@ -110,7 +183,7 @@ def main() -> None:
         bubble = (sizes["pipe"] - 1) / (args.microbatches + sizes["pipe"] - 1)
         kind = args.schedule
     for i in range(args.steps):
-        opt_state, params, m = step(opt_state, params, tokens_fixed)
+        opt_state, params, m = step(opt_state, params, next(batches))
         if i % 5 == 0:
             print(f"step {i}: loss={float(m['loss']):.4f}")
     print(f"done: {n_params/1e6:.1f}M params over {sizes['pipe']} stages x "

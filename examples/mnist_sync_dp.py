@@ -141,9 +141,7 @@ def main() -> None:
                     dp.shard_batch(decode_mnist_batch(next(it)))
                     for _ in range(n)
                 ]
-                loader_close = getattr(eval_loader, "close", None)
-                if loader_close:
-                    loader_close()
+                eval_loader.close()
 
                 def make_eval_data():
                     return eval_batches

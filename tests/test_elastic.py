@@ -319,6 +319,9 @@ def test_preemption_sync_every_cadence_and_final_drain(tmp_path):
     assert loop.step == TOTAL_STEPS  # cadence held: no mid-run stop
     assert hook.preempted_at == TOTAL_STEPS  # drain saved at the end
     assert ckpt.latest_step() == TOTAL_STEPS
+    # the drain retags the stop so later end-phase hooks (EvalHook) skip
+    # grace-window-eating work even on this late-flag path
+    assert loop.stop_reason == "preemption"
     ckpt.close()
 
     # cadence-aligned flag: acts at the agreement point, not before

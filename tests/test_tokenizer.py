@@ -1,0 +1,132 @@
+"""Byte-level BPE tokenizer + text-record pipeline (data/tokenizer.py).
+
+The contracts the LM configs rely on: exact roundtrip for arbitrary input
+(byte fallback, no <unk>), deterministic training, vocab persistence, and
+corpus -> records -> loader parity with direct tokenization (native and
+Python loaders byte-identical, as everywhere else in data/).
+"""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_guide_tpu.data.native_loader import (
+    PyRecordLoader,
+    load_native_lib,
+    open_record_loader,
+)
+from distributed_tensorflow_guide_tpu.data.tokenizer import (
+    ByteBPETokenizer,
+    ByteTokenizer,
+    import_text,
+    text_fields,
+)
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. " * 40
+    + "pack my box with five dozen liquor jugs! " * 30
+    + "héllo wörld — ünïcode ✓ 测试 " * 10
+)
+
+HARD_CASES = [
+    "",
+    "plain ascii",
+    "  leading and trailing  ",
+    "tabs\tand\nnewlines\r\n",
+    "\x00\x01\x02 control bytes \x7f",
+    "héllo wörld — ünïcode ✓",
+    "测试中文 with mixed ascii",
+    "🙂🙃 emoji pairs 👩‍👩‍👧‍👧",
+    "never-seen-at-training xyzzy qwfpgj",
+]
+
+
+@pytest.fixture(scope="module")
+def bpe():
+    return ByteBPETokenizer.train(CORPUS, vocab_size=512)
+
+
+@pytest.mark.parametrize("text", HARD_CASES)
+def test_bpe_roundtrip_exact(bpe, text):
+    assert bpe.decode(bpe.encode(text)) == text
+
+
+@pytest.mark.parametrize("text", HARD_CASES)
+def test_byte_tokenizer_roundtrip_exact(text):
+    bt = ByteTokenizer()
+    ids = bt.encode(text)
+    assert bt.decode(ids) == text
+    assert all(0 <= i < 256 for i in ids)
+
+
+def test_bpe_compresses_training_distribution(bpe):
+    ids = bpe.encode(CORPUS)
+    n_bytes = len(CORPUS.encode())
+    assert len(ids) < n_bytes / 2, (len(ids), n_bytes)
+    assert max(ids) >= 256  # merges actually used
+    assert bpe.vocab_size == 256 + len(bpe.merges) + 1
+    assert bpe.eos_id == bpe.vocab_size - 1
+
+
+def test_bpe_training_is_deterministic():
+    a = ByteBPETokenizer.train(CORPUS, vocab_size=400)
+    b = ByteBPETokenizer.train(CORPUS, vocab_size=400)
+    assert a.merges == b.merges
+
+
+def test_bpe_save_load_identity(bpe, tmp_path):
+    p = tmp_path / "vocab.json"
+    bpe.save(p)
+    again = ByteBPETokenizer.load(p)
+    assert again.merges == bpe.merges
+    for text in HARD_CASES:
+        assert again.encode(text) == bpe.encode(text)
+    (tmp_path / "bad.json").write_text('{"format": "other"}')
+    with pytest.raises(ValueError, match="vocab file"):
+        ByteBPETokenizer.load(tmp_path / "bad.json")
+
+
+def test_bpe_rejects_tiny_vocab():
+    with pytest.raises(ValueError, match="258"):
+        ByteBPETokenizer.train("x", vocab_size=257)
+
+
+def test_import_text_records_match_direct_tokenization(bpe, tmp_path):
+    """Loader parity: the records stream exactly encode(corpus)+[EOS],
+    windowed — through BOTH loaders."""
+    corpus = tmp_path / "c.txt"
+    corpus.write_text(CORPUS)
+    rec = tmp_path / "c.records"
+    seq_len = 32
+    n = import_text(corpus, rec, bpe, seq_len)
+
+    expect = bpe.encode(CORPUS) + [bpe.eos_id]
+    assert n == len(expect) // seq_len
+    want = np.asarray(expect[: n * seq_len], np.int32).reshape(n, seq_len)
+
+    py = PyRecordLoader(rec, text_fields(seq_len), batch_size=n,
+                        shuffle=False)
+    np.testing.assert_array_equal(py.next_batch()["tokens"], want)
+
+    if load_native_lib() is not None:
+        native = open_record_loader(rec, text_fields(seq_len), batch_size=n,
+                                    shuffle=False)
+        np.testing.assert_array_equal(native.next_batch()["tokens"], want)
+        native.close()
+
+
+def test_import_text_rewrites_clean(bpe, tmp_path):
+    """A re-import must replace the record file, not append to it."""
+    corpus = tmp_path / "c.txt"
+    corpus.write_text(CORPUS)
+    rec = tmp_path / "c.records"
+    n1 = import_text(corpus, rec, bpe, 32)
+    n2 = import_text(corpus, rec, bpe, 32)
+    assert n1 == n2
+    assert rec.stat().st_size == n1 * 32 * 4
+
+
+def test_import_text_too_small_raises(bpe, tmp_path):
+    corpus = tmp_path / "tiny.txt"
+    corpus.write_text("ab")
+    with pytest.raises(ValueError, match="seq_len"):
+        import_text(corpus, tmp_path / "t.records", bpe, 4096)
