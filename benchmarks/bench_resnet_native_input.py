@@ -45,6 +45,11 @@ def main() -> None:
     ap.add_argument("--image-size", type=int, default=224,
                     help="records are (S, S, 3) uint8; 224 = the judged "
                          "ImageNet shape (CPU smoke tests shrink it)")
+    ap.add_argument("--augment", action="store_true",
+                    help="ImageNet train recipe geometry: store records at "
+                         "(S+32, S+32), random-crop to (S, S) + hflip in "
+                         "the C++ gather copy — the augmented input-path "
+                         "contract, not a memcpy")
     args = ap.parse_args()
 
     device_setup(args.fake_devices)
@@ -76,14 +81,25 @@ def main() -> None:
     mesh = build_mesh(MeshSpec(data=-1))
     dp = DataParallel(mesh)
     size = args.image_size
-    rec_bytes = size * size * 3 + 4
 
     # 1. ImageNet-shaped uint8 records, written in chunks (the full file can
-    # exceed RAM-friendly single-array sizes at larger --records)
+    # exceed RAM-friendly single-array sizes at larger --records). With
+    # --augment, records store (S+32, S+32) and the loader crops to (S, S):
+    # the classic ImageNet train geometry, applied in the C++ gather copy.
+    stored = size + 32 if args.augment else size
+    rec_bytes = stored * stored * 3 + 4
     fields = make_fields({
-        "image": (np.uint8, (size, size, 3)),
+        "image": (np.uint8, (stored, stored, 3)),
         "label": (np.int32, ()),
     })
+    augment = None
+    if args.augment:
+        from distributed_tensorflow_guide_tpu.data.native_loader import (
+            ImageAugment,
+        )
+
+        augment = ImageAugment(in_shape=(stored, stored, 3),
+                               crop=(size, size), hflip=True)
     r = np.random.RandomState(0)
     tmp = tempfile.NamedTemporaryFile(suffix=".rec", delete=False)
     tmp.close()
@@ -92,7 +108,8 @@ def main() -> None:
     while done < args.records:  # bounded-memory chunked append
         n = min(chunk, args.records - done)
         write_records(tmp.name, {
-            "image": r.randint(0, 256, (n, size, size, 3), dtype=np.uint8),
+            "image": r.randint(0, 256, (n, stored, stored, 3),
+                               dtype=np.uint8),
             "label": r.randint(0, 1000, n).astype(np.int32),
         }, fields, append=done > 0)
         done += n
@@ -129,6 +146,7 @@ def main() -> None:
         loader = NativeRecordLoader(
             tmp.name, fields, args.global_batch,
             prefetch=args.prefetch, n_threads=args.threads, seed=1,
+            augment=augment,
         )
         for _ in range(args.prefetch + 1):
             loader.next_batch()  # consume the pre-filled ring credit
@@ -155,6 +173,7 @@ def main() -> None:
         loader = NativeRecordLoader(
             tmp.name, fields, args.global_batch,
             prefetch=args.prefetch, n_threads=args.threads, seed=2,
+            augment=augment,
         )
         state = fresh_state()
         for _ in range(2):
@@ -176,6 +195,7 @@ def main() -> None:
         device_ceiling_images_per_sec=round(ceiling, 1),
         record_kib=round(rec_bytes / 1024, 1),
         loader_mb_per_sec=round(loader_only * rec_bytes / 2**20, 1),
+        augmented=bool(augment),
     )
 
 

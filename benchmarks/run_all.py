@@ -65,8 +65,10 @@ SMOKE = {
         ["--fake-devices", "8", "--context", "4", "--seq-len", "512",
          "--heads", "8", "--head-dim", "16"],
     "bench_resnet_native_input.py":
+        # --augment: crop+flip in the C++ gather copy — the input-path
+        # contract the judged ResNet config trains under (round-5)
         ["--fake-devices", "4", "--global-batch", "16", "--records", "128",
-         "--steps", "3", "--image-size", "64"],
+         "--steps", "3", "--image-size", "64", "--augment"],
 }
 
 

@@ -1,5 +1,6 @@
 from distributed_tensorflow_guide_tpu.data.native_loader import (  # noqa: F401
     Field,
+    ImageAugment,
     NativeRecordLoader,
     PyRecordLoader,
     make_fields,

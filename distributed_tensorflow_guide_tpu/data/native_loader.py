@@ -75,12 +75,20 @@ def load_native_lib() -> ctypes.CDLL | None:
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
             ctypes.c_int,
         ]
+        lib.dl_open_aug.restype = ctypes.c_void_p
+        lib.dl_open_aug.argtypes = lib.dl_open.argtypes + [
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # in_h/in_w/chan
+            ctypes.c_int64, ctypes.c_int64,                  # crop_h/crop_w
+            ctypes.c_int64, ctypes.c_int,                    # extra, hflip
+        ]
         lib.dl_next.restype = ctypes.c_int64
         lib.dl_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.dl_batches_per_epoch.restype = ctypes.c_int64
         lib.dl_batches_per_epoch.argtypes = [ctypes.c_void_p]
         lib.dl_num_records.restype = ctypes.c_int64
         lib.dl_num_records.argtypes = [ctypes.c_void_p]
+        lib.dl_record_bytes_out.restype = ctypes.c_int64
+        lib.dl_record_bytes_out.argtypes = [ctypes.c_void_p]
         lib.dl_close.argtypes = [ctypes.c_void_p]
         _LIB_CACHE[key] = lib
     return _LIB_CACHE[key]
@@ -140,6 +148,75 @@ def epoch_permutation(n_records: int, seed: int, epoch: int) -> np.ndarray:
         j = rng.bounded(i + 1)
         idx[i], idx[j] = idx[j], idx[i]
     return idx
+
+
+# -- image augmentation (shared spec: C++ does it in the gather copy) --------
+
+
+def _aug_seed(seed: int, epoch: int, idx: int) -> int:
+    """Per-record augmentation seed — keep in lockstep with aug_seed() in
+    native/dataloader.cpp. Pure in (seed, epoch, record index): the same
+    record gets the same crop/flip in a given epoch no matter the shuffle
+    order, shard layout, or loader implementation."""
+    return (seed * 0x9E3779B97F4A7C15
+            + (epoch + 1) * 0xBF58476D1CE4E5B9 + idx) & MASK64
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageAugment:
+    """Deterministic train-time crop+flip, applied by the loader tier.
+
+    Records store a slightly-larger-than-train image, e.g. (256, 256, 3)
+    uint8, cropped to (224, 224) per epoch — the classic ImageNet recipe's
+    geometry without JPEG (this environment has no image corpus; decoded-
+    pixel records at the right byte scale are the honest contract). The
+    C++ loader augments DURING the gather copy (one pass over the bytes,
+    same cost class as the memcpy it replaces); the Python twin mirrors it
+    bit-exactly. Draws: y0, x0, flip — in that order — from
+    ``Rng(_aug_seed(seed, epoch, index))``.
+    """
+
+    in_shape: tuple[int, int, int]   # (h, w, c) as stored
+    crop: tuple[int, int]            # (crop_h, crop_w) as trained
+    hflip: bool = True
+
+    def __post_init__(self):
+        h, w, c = self.in_shape
+        ch, cw = self.crop
+        if not (0 < ch <= h and 0 < cw <= w and c > 0):
+            raise ValueError(
+                f"crop {self.crop} must fit inside in_shape {self.in_shape}")
+
+    @property
+    def image_bytes_in(self) -> int:
+        h, w, c = self.in_shape
+        return h * w * c
+
+    def out_fields(self, fields: "Sequence[Field]") -> list["Field"]:
+        """The batch layout after augmentation: the leading image field
+        shrinks to the crop; everything after it passes through."""
+        img = fields[0]
+        if img.dtype != np.uint8 or tuple(img.shape) != self.in_shape:
+            raise ValueError(
+                f"augmentation needs a leading uint8 image field of shape "
+                f"{self.in_shape}; got {img.dtype} {img.shape}")
+        ch, cw = self.crop
+        return [Field(img.name, img.dtype, (ch, cw, self.in_shape[2])),
+                *fields[1:]]
+
+    def apply_one(self, record: np.ndarray, rng: "_Xoshiro256ss") -> np.ndarray:
+        """Python-twin augmentation of one packed record (uint8 row)."""
+        h, w, c = self.in_shape
+        ch, cw = self.crop
+        img = record[: h * w * c].reshape(h, w, c)
+        y0 = rng.bounded(h - ch + 1)
+        x0 = rng.bounded(w - cw + 1)
+        flip = self.hflip and (rng.next() & 1)
+        crop = img[y0:y0 + ch, x0:x0 + cw]
+        if flip:
+            crop = crop[:, ::-1]
+        return np.concatenate(
+            [np.ascontiguousarray(crop).reshape(-1), record[h * w * c:]])
 
 
 # -- record/field plumbing ---------------------------------------------------
@@ -226,7 +303,7 @@ class NativeRecordLoader:
     def __init__(self, path: str | Path, fields: Sequence[Field],
                  batch_size: int, *, shard_id: int = 0, num_shards: int = 1,
                  shuffle: bool = True, seed: int = 0, prefetch: int = 4,
-                 n_threads: int = 4):
+                 n_threads: int = 4, augment: ImageAugment | None = None):
         self.fields = list(fields)
         self.batch_size = batch_size
         self._rb = record_bytes(self.fields)
@@ -234,14 +311,29 @@ class NativeRecordLoader:
         if lib is None:
             raise RuntimeError("native loader unavailable; use PyRecordLoader")
         self._lib = lib
-        self._h = lib.dl_open(str(path).encode(), self._rb, batch_size,
-                              shard_id, num_shards, prefetch, n_threads,
-                              ctypes.c_uint64(seed & MASK64), int(shuffle))
+        if augment is None:
+            self._h = lib.dl_open(str(path).encode(), self._rb, batch_size,
+                                  shard_id, num_shards, prefetch, n_threads,
+                                  ctypes.c_uint64(seed & MASK64),
+                                  int(shuffle))
+        else:
+            self.fields = augment.out_fields(self.fields)  # batch layout
+            h, w, c = augment.in_shape
+            ch, cw = augment.crop
+            self._h = lib.dl_open_aug(
+                str(path).encode(), self._rb, batch_size, shard_id,
+                num_shards, prefetch, n_threads,
+                ctypes.c_uint64(seed & MASK64), int(shuffle),
+                h, w, c, ch, cw, self._rb - augment.image_bytes_in,
+                int(augment.hflip))
         if not self._h:
             raise ValueError(
                 f"dl_open failed for {path} (record_bytes={self._rb}, "
                 f"batch={batch_size}, shard {shard_id}/{num_shards} — file "
                 "must be a whole number of records and >= one batch/shard)")
+        if augment is not None:
+            self._rb = int(lib.dl_record_bytes_out(self._h))
+            assert self._rb == record_bytes(self.fields)
         self._buf = ctypes.create_string_buffer(batch_size * self._rb)
 
     @property
@@ -287,10 +379,14 @@ class PyRecordLoader:
 
     def __init__(self, path: str | Path, fields: Sequence[Field],
                  batch_size: int, *, shard_id: int = 0, num_shards: int = 1,
-                 shuffle: bool = True, seed: int = 0):
+                 shuffle: bool = True, seed: int = 0,
+                 augment: ImageAugment | None = None):
         self.fields = list(fields)
         self.batch_size = batch_size
         self._rb = record_bytes(self.fields)
+        self.augment = augment
+        if augment is not None:
+            self.fields = augment.out_fields(self.fields)
         data = np.fromfile(str(path), np.uint8)
         if data.size == 0 or data.size % self._rb:
             raise ValueError(f"{path}: not a whole number of records")
@@ -328,7 +424,17 @@ class PyRecordLoader:
         idx = self._indices[self._pos * self.batch_size:
                             (self._pos + 1) * self.batch_size]
         self._pos += 1
-        return _split_batch(self._records[idx], self.fields)
+        raw = self._records[idx]
+        if self.augment is not None:
+            raw = np.stack([
+                self.augment.apply_one(
+                    raw[r],
+                    _Xoshiro256ss(_aug_seed(self.seed, self._epoch,
+                                            int(idx[r]))),
+                )
+                for r in range(raw.shape[0])
+            ])
+        return _split_batch(raw, self.fields)
 
     def close(self) -> None:
         # Interface parity with NativeRecordLoader only: the Python twin

@@ -26,6 +26,7 @@ path, so the chip never waits on Python string handling.
 from __future__ import annotations
 
 import json
+import os
 import re
 from pathlib import Path
 from typing import Iterable, Sequence
@@ -42,6 +43,24 @@ from distributed_tensorflow_guide_tpu.data.native_loader import (
 # other whitespace, or leading-of-text words. Byte-level: applied to the
 # raw utf-8 bytes, so no unicode table is needed at encode time.
 _PRETOKEN = re.compile(rb" ?[^\s]+|\s+")
+
+# whitespace-free input (minified JS, base64 blobs, long URLs) yields one
+# giant pre-token, and the merge loop is O(L^2) in pre-token length — a
+# 100 KB blob would effectively hang encode. Capping the piece length
+# bounds the cost; merges simply never span a cap boundary (negligible
+# compression loss on pathological inputs, zero on prose) and roundtrip
+# exactness is untouched.
+_MAX_PRETOKEN = 1024
+
+
+def _pretokens(data: bytes):
+    for m in _PRETOKEN.finditer(data):
+        w = m.group()
+        if len(w) <= _MAX_PRETOKEN:
+            yield w
+        else:
+            for i in range(0, len(w), _MAX_PRETOKEN):
+                yield w[i:i + _MAX_PRETOKEN]
 
 
 class ByteTokenizer:
@@ -96,8 +115,7 @@ class ByteBPETokenizer:
         data = text.encode("utf-8") if isinstance(text, str) else bytes(text)
         # word -> frequency; BPE statistics over types, not tokens
         freqs: dict[bytes, int] = {}
-        for m in _PRETOKEN.finditer(data):
-            w = m.group()
+        for w in _pretokens(data):
             freqs[w] = freqs.get(w, 0) + 1
         words = [(list(w), f) for w, f in freqs.items()]
         merges: list[tuple[int, int]] = []
@@ -149,8 +167,8 @@ class ByteBPETokenizer:
     def encode(self, text: str | bytes) -> list[int]:
         data = text.encode("utf-8") if isinstance(text, str) else bytes(text)
         out: list[int] = []
-        for m in _PRETOKEN.finditer(data):
-            out.extend(self._encode_word(m.group()))
+        for w in _pretokens(data):
+            out.extend(self._encode_word(w))
         return out
 
     def decode(self, ids: Iterable[int]) -> str:
@@ -205,8 +223,15 @@ def import_text(corpus: str | Path, out: str | Path, tokenizer,
     fields = text_fields(seq_len)
     arr = np.asarray(ids[:n_records * seq_len], np.int32).reshape(
         n_records, seq_len)
-    out.unlink(missing_ok=True)  # append below must start clean
-    for lo in range(0, n_records, chunk_records):
-        write_records(out, {"tokens": arr[lo:lo + chunk_records]}, fields,
-                      append=lo > 0)
+    # write-to-temp + atomic replace (the _build_lib convention): an
+    # interrupted import must never leave a truncated-but-valid record
+    # file behind for an mtime-keyed cache to silently reuse
+    tmp = out.with_suffix(out.suffix + f".tmp{os.getpid()}")
+    try:
+        for lo in range(0, n_records, chunk_records):
+            write_records(tmp, {"tokens": arr[lo:lo + chunk_records]},
+                          fields, append=lo > 0)
+        os.replace(tmp, out)
+    finally:
+        tmp.unlink(missing_ok=True)
     return n_records

@@ -6,6 +6,7 @@ import pytest
 
 from distributed_tensorflow_guide_tpu.data.native_loader import (
     Field,
+    ImageAugment,
     NativeRecordLoader,
     PyRecordLoader,
     epoch_permutation,
@@ -221,3 +222,92 @@ def test_native_loader_feeds_pipelined_lm(tmp_path):
     assert all(np.isfinite(losses)), losses
     assert loader.num_records == n_records
     loader.close()
+
+
+# -- train-time image augmentation (round-5: crop+flip in the loader tier) ---
+
+AUG_FIELDS = make_fields({
+    "image": (np.uint8, (40, 40, 3)),
+    "label": (np.int32, ()),
+})
+AUG = ImageAugment(in_shape=(40, 40, 3), crop=(32, 32), hflip=True)
+
+
+@pytest.fixture(scope="module")
+def aug_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("aug") / "imgs.records"
+    rng = np.random.RandomState(3)
+    n = 64
+    cols = {"image": rng.randint(0, 256, (n, 40, 40, 3)).astype(np.uint8),
+            "label": np.arange(n, dtype=np.int32)}
+    write_records(path, cols, AUG_FIELDS)
+    return path, cols
+
+
+@needs_native
+def test_augmented_native_matches_python_twin(aug_file):
+    """The bit-identical-streams contract extends to augmentation: the C++
+    gather-copy crop/flip and the Python twin agree byte-for-byte, across
+    an epoch boundary (epoch is part of the draw seed)."""
+    path, _ = aug_file
+    kw = dict(batch_size=8, shuffle=True, seed=5, augment=AUG)
+    nat = NativeRecordLoader(path, AUG_FIELDS, **kw)
+    py = PyRecordLoader(path, AUG_FIELDS, **kw)
+    assert nat.batches_per_epoch == py.batches_per_epoch == 8
+    for i in range(20):  # 2.5 epochs
+        a, b = nat.next_batch(), py.next_batch()
+        assert a["image"].shape == (8, 32, 32, 3)
+        np.testing.assert_array_equal(a["image"], b["image"], err_msg=str(i))
+        np.testing.assert_array_equal(a["label"], b["label"])
+    nat.close()
+
+
+def test_augmentation_pinned_to_seed_epoch_index(aug_file):
+    """The determinism contract: draws are a pure function of
+    (seed, epoch, record index) — invariant to shuffle order; changed by
+    epoch and by seed."""
+    path, cols = aug_file
+    # unshuffled epoch 0: record r of batch 0 is global index r
+    py = PyRecordLoader(path, AUG_FIELDS, batch_size=64, shuffle=False,
+                        seed=5, augment=AUG)
+    plain = py.next_batch()
+
+    # same records reached through a SHUFFLED loader get the SAME crops:
+    # find each record by label and compare
+    sh = PyRecordLoader(path, AUG_FIELDS, batch_size=64, shuffle=True,
+                        seed=5, augment=AUG)
+    shuffled = sh.next_batch()
+    order = np.argsort(shuffled["label"])
+    np.testing.assert_array_equal(shuffled["image"][order], plain["image"])
+
+    # epoch 1 re-crops (epoch is in the seed): some record must differ
+    e1 = py.next_batch()  # advances to epoch 1 (64 = one full epoch)
+    assert py._epoch == 1
+    assert not np.array_equal(e1["image"], plain["image"])
+
+    # a different seed re-crops too
+    other = PyRecordLoader(path, AUG_FIELDS, batch_size=64, shuffle=False,
+                           seed=6, augment=AUG)
+    assert not np.array_equal(other.next_batch()["image"], plain["image"])
+
+    # crops are genuine views of the stored image: every augmented image
+    # appears somewhere in its source (check one record exhaustively)
+    src = cols["image"][0]
+    out = plain["image"][0]
+    found = any(
+        np.array_equal(src[y:y + 32, x:x + 32], cand)
+        for cand in (out, out[:, ::-1])
+        for y in range(9) for x in range(9)
+    )
+    assert found
+
+
+def test_augment_spec_validation(aug_file):
+    path, _ = aug_file
+    with pytest.raises(ValueError, match="must fit"):
+        ImageAugment(in_shape=(40, 40, 3), crop=(41, 32))
+    # leading field must be the uint8 image at the declared shape
+    bad = make_fields({"label": (np.int32, ()),
+                       "image": (np.uint8, (40, 40, 3))})
+    with pytest.raises(ValueError, match="leading uint8 image"):
+        PyRecordLoader(path, bad, batch_size=8, augment=AUG)

@@ -125,6 +125,19 @@ def test_import_text_rewrites_clean(bpe, tmp_path):
     assert rec.stat().st_size == n1 * 32 * 4
 
 
+def test_giant_pretoken_bounded(bpe):
+    """Whitespace-free input (base64 blob / minified JS) must encode in
+    bounded time AND still roundtrip exactly (pre-tokens are capped, not
+    dropped)."""
+    import time
+
+    blob = "QUJDREVGR0hJSktMTU5PUA==" * 8000  # ~200 KB, no whitespace
+    t0 = time.time()
+    ids = bpe.encode(blob)
+    assert time.time() - t0 < 10.0
+    assert bpe.decode(ids) == blob
+
+
 def test_import_text_too_small_raises(bpe, tmp_path):
     corpus = tmp_path / "tiny.txt"
     corpus.write_text("ab")
