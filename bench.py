@@ -200,6 +200,23 @@ def run_bench() -> None:
     trial_tput.sort()
     median = trial_tput[len(trial_tput) // 2]
     spread_pct = 100.0 * (trial_tput[-1] - trial_tput[0]) / median
+
+    # MFU accounting (model-FLOP convention: 3x the traced forward; conv +
+    # dot FLOPs from the jaxpr walker — abstract trace, no compile). Per
+    # chip: the forward is traced on the per-chip batch.
+    from benchmarks.common import mfu_extras, model_flops_per_step
+
+    loss_fn = make_loss_fn(model)
+    abstract_batch = {
+        "image": jax.ShapeDtypeStruct(
+            (per_chip_batch, image_size, image_size, 3), jnp.float32),
+        "label": jax.ShapeDtypeStruct((per_chip_batch,), jnp.int32),
+    }
+    p_abs, ms_abs = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), (params, model_state))
+    step_flops = model_flops_per_step(loss_fn, p_abs, ms_abs, abstract_batch)
+    # median is img/s/chip; one "step" here = one per-chip batch
+    dt_per_step = per_chip_batch / median
     print(
         json.dumps(
             {
@@ -213,6 +230,7 @@ def run_bench() -> None:
                 # mistaken for the judged config (256, no remat)
                 "per_chip_batch": per_chip_batch,
                 "remat": remat,
+                **mfu_extras(step_flops, 1, dt_per_step, a100_mfu=None),
             }
         )
     )
@@ -298,6 +316,7 @@ def orchestrate() -> int:
             print(f"[bench] relay pre-probe ok: listeners on {ports}",
                   file=sys.stderr)
     failures: list[str] = []
+    hangs = 0
     for attempt in range(MAX_ATTEMPTS):
         if attempt:
             time.sleep(BACKOFF_S[min(attempt - 1, len(BACKOFF_S) - 1)])
@@ -307,7 +326,29 @@ def orchestrate() -> int:
                             + " | ".join(out.strip().splitlines()[-2:]))
             print(f"[bench] probe failed (attempt {attempt + 1}/{MAX_ATTEMPTS},"
                   f" rc={rc}); backing off", file=sys.stderr)
+            # Hang-vs-error asymmetry, learned the hard way across rounds
+            # 3-5: a probe that ERRORS (plugin raised, relay refused) can be
+            # transient and is worth all MAX_ATTEMPTS retries, but a probe
+            # that HANGS to its kill timeout means the relay accepted the
+            # connection and the backend behind it is wedged — in three
+            # observed outages that state never recovered within any retry
+            # budget. Two consecutive hangs end the round at ~5 min instead
+            # of 12, leaving the driver capture budget for a later flap-back.
+            if rc == "timeout":
+                hangs += 1
+                if hangs >= 2:
+                    print(_diagnostic_line(
+                        "TPU backend hung (relay listening but probe hit its "
+                        f"{PROBE_TIMEOUT_S:.0f}s kill timeout twice in "
+                        f"{time.time() - t_start:.0f}s); historically this "
+                        "state does not recover within the capture budget",
+                        attempts=failures,
+                    ))
+                    return 1
+            else:
+                hangs = 0
             continue
+        hangs = 0
         rc, out = _child("--run", RUN_TIMEOUT_S)
         result = _extract_json_line(out) if rc == 0 else None
         if result is not None:

@@ -11,7 +11,13 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-from benchmarks.common import device_setup, report, time_steps
+from benchmarks.common import (
+    device_setup,
+    lm_model_flops_per_step,
+    mfu_extras,
+    report,
+    time_steps,
+)
 
 
 def main() -> None:
@@ -78,7 +84,9 @@ def main() -> None:
     batch = {"tokens": tokens, "label": labels}
     dt, _ = time_steps(step, state, batch, steps=args.steps)
     report("bert_base_tensor_parallel_throughput",
-           args.global_batch * args.steps / dt, "sequences/sec")
+           args.global_batch * args.steps / dt, "sequences/sec",
+           **mfu_extras(lm_model_flops_per_step(cfg, args.global_batch),
+                        args.steps, dt, n_devices=mesh.devices.size))
 
 
 if __name__ == "__main__":

@@ -12,7 +12,13 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-from benchmarks.common import device_setup, report, time_steps
+from benchmarks.common import (
+    device_setup,
+    lm_model_flops_per_step,
+    mfu_extras,
+    report,
+    time_steps,
+)
 
 
 def main() -> None:
@@ -101,7 +107,9 @@ def main() -> None:
     dt, _ = time_steps(step2, (opt_state, params), tokens, steps=args.steps)
 
     report("gpt2_124m_pipeline_throughput",
-           global_batch * cfg.max_len * args.steps / dt, "tokens/sec")
+           global_batch * cfg.max_len * args.steps / dt, "tokens/sec",
+           **mfu_extras(lm_model_flops_per_step(cfg, global_batch),
+                        args.steps, dt, n_devices=mesh.devices.size))
 
 
 if __name__ == "__main__":

@@ -130,6 +130,125 @@ def time_steps(
     return time.perf_counter() - t0, state
 
 
+# Per-chip dense bf16 peak FLOP/s from the public spec sheets, keyed on
+# substrings of jax's device_kind. v5 lite = 197 TF bf16 (394 int8); the
+# rest are here so the same benches report MFU if the attached part changes.
+_TPU_BF16_PEAK: dict[str, float] = {
+    "v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 46e12,
+}
+
+# The NCCL baseline part (BASELINE.json: 8xA100). 312 TF dense bf16/chip.
+A100_BF16_PEAK = 312e12
+
+
+def device_peak_flops() -> float | None:
+    """Dense bf16 peak of the attached accelerator, or None off-TPU.
+
+    CPU (incl. the fake-device meshes) deliberately returns None — an MFU
+    against a CPU "peak" would be noise, so report() callers emit MFU keys
+    only on real hardware.
+    """
+    import jax
+
+    d = jax.devices()[0]
+    if d.platform != "tpu":
+        return None
+    kind = d.device_kind.lower()
+    for key, peak in _TPU_BF16_PEAK.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def lm_model_flops_per_step(cfg, global_batch: int) -> float:
+    """Logical model FLOPs of ONE training step of a Transformer config:
+    3x the traced forward pass (backward = 2x forward, PaLM App. B).
+
+    This is the MFU numerator of record — the *model* FLOP convention:
+    remat recomputation is deliberately NOT counted (that is scheduled
+    overhead, not model work), which is why the forward is traced with
+    ``remat=False``. Attention is traced ``dense`` so the flash-kernel path
+    (whose Pallas grid the jaxpr walker cannot expand) counts its logical
+    dot_generals instead. Tracing is abstract (ShapeDtypeStruct) — no
+    device, no compile.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        Transformer,
+        make_cls_loss_fn,
+        make_lm_loss_fn,
+    )
+
+    flop_cfg = dataclasses.replace(
+        cfg, attn_impl="dense", remat=False, tp_axis=None,
+        override_head_dim=None)
+    model = Transformer(flop_cfg)
+    tokens = jax.ShapeDtypeStruct((global_batch, flop_cfg.max_len), jnp.int32)
+    params = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0), tokens)["params"]
+    if flop_cfg.num_classes is None:
+        loss_fn = make_lm_loss_fn(model)
+        batch = {"tokens": tokens}
+    else:
+        loss_fn = make_cls_loss_fn(model)
+        batch = {"tokens": tokens,
+                 "label": jax.ShapeDtypeStruct((global_batch,), jnp.int32)}
+    return model_flops_per_step(loss_fn, params, batch)
+
+
+def model_flops_per_step(loss_fn, *abstract_args) -> float:
+    """One train step's model FLOPs from a traced forward: owns the
+    3x-forward convention (backward = 2x forward, PaLM App. B) so every
+    bench reports MFU on the same numerator."""
+    from distributed_tensorflow_guide_tpu.utils.flop_accounting import (
+        traced_matmul_flops,
+    )
+
+    return 3.0 * traced_matmul_flops(loss_fn, *abstract_args)
+
+
+def mfu_extras(model_flops_per_step: float, steps: int, dt: float,
+               n_devices: int = 1,
+               a100_mfu: float | None = 0.37) -> dict:
+    """Extra report() keys: achieved model TFLOP/s, MFU vs the attached
+    part's peak x ``n_devices`` (pass the mesh size when
+    ``model_flops_per_step`` covers a global batch executed across the whole
+    mesh — dividing mesh-wide FLOP/s by one chip's peak would inflate MFU
+    by the device count), and — when ``a100_mfu`` is given — the
+    A100-equivalent step time from the SAME FLOP count at that utilization.
+    The 0.37 default is the transformer-LM figure (nanoGPT-class GPT-2 124M
+    sustains ~37% MFU on A100; docs/performance.md); pass ``None`` for
+    workloads with their own measured A100 baseline (ResNet's MLPerf-class
+    img/s constant in bench.py works out to ~11% MFU — the 37% constant
+    would contradict it ~3x)."""
+    achieved = model_flops_per_step * steps / dt
+    out: dict = {
+        "model_tflops_per_sec": round(achieved / 1e12, 2),
+        "flops_per_step": model_flops_per_step,
+    }
+    peak = device_peak_flops()
+    if peak:
+        peak *= n_devices
+        out["mfu"] = round(achieved / peak, 4)
+        out["peak_tflops"] = round(peak / 1e12, 1)
+        if a100_mfu:
+            a100_step_s = model_flops_per_step / (
+                a100_mfu * A100_BF16_PEAK * n_devices)
+            out["a100_equiv_step_s"] = round(a100_step_s, 4)
+            out["a100_mfu_assumed"] = a100_mfu
+            out["vs_a100_equal_chips"] = round((a100_step_s * steps) / dt, 3)
+    return out
+
+
 def report(metric: str, value: float, unit: str,
            baseline: float | None = None, **extra) -> None:
     """Print the single JSON result line.

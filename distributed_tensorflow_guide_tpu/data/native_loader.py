@@ -333,7 +333,14 @@ class NativeRecordLoader:
                 "must be a whole number of records and >= one batch/shard)")
         if augment is not None:
             self._rb = int(lib.dl_record_bytes_out(self._h))
-            assert self._rb == record_bytes(self.fields)
+            if self._rb != record_bytes(self.fields):
+                # Cross-language layout check (C++ out_record_bytes vs the
+                # Python out-field view) — a real ValueError, not an assert:
+                # under -O a silent mismatch here would reinterpret
+                # misaligned bytes into garbled arrays much later.
+                raise ValueError(
+                    f"native loader out-record size {self._rb} != Python "
+                    f"field layout {record_bytes(self.fields)} bytes")
         self._buf = ctypes.create_string_buffer(batch_size * self._rb)
 
     @property
