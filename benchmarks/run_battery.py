@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""On-chip capture battery: every number of record, one command.
+
+Runs the full benchmark suite in a fixed order, each bench in its own
+subprocess with a hard timeout, and appends one JSON object per bench to
+``bench_results/battery_<stamp>.jsonl`` — the bench's own result line plus
+{name, argv, rc, secs, tail-on-failure}. A bench that fails or hangs does
+not stop the battery (the chip may flap mid-capture; partial evidence
+beats none).
+
+Order is by evidence value for the round: flagship ResNet first (the
+driver's metric), then the compute-bound MFU configs (GPT-2 pipeline,
+BERT TP), the round-4 wire-format claims (ring attention, SP comm), the
+dense-attention repro, then the rest of the suite.
+
+Use ``--only NAME...`` to re-run a subset, ``--list`` to see names.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+
+# (name, argv, timeout_s) — argv relative to repo root.
+BATTERY: list[tuple[str, list[str], int]] = [
+    ("resnet_flagship", ["bench.py"], 2400),
+    ("gpt2_pp_1f1b", ["benchmarks/bench_gpt2_pp.py"], 1800),
+    ("gpt2_pp_interleaved_1f1b",
+     ["benchmarks/bench_gpt2_pp.py", "--virtual-chunks", "2"], 1800),
+    ("gpt2_pp_gpipe",
+     ["benchmarks/bench_gpt2_pp.py", "--schedule", "gpipe"], 1800),
+    ("gpt2_flash_seq1024",
+     ["benchmarks/bench_gpt2_pp.py", "--seq-len", "1024",
+      "--microbatch-size", "1"], 1800),
+    ("gpt2_flash_seq2048",
+     ["benchmarks/bench_gpt2_pp.py", "--seq-len", "2048",
+      "--microbatch-size", "1"], 1800),
+    ("bert_tp", ["benchmarks/bench_bert_tp.py"], 1800),
+    ("ring_attention_1024",
+     ["benchmarks/bench_ring_attention.py", "--seq-len", "1024"], 1500),
+    ("ring_attention_2048",
+     ["benchmarks/bench_ring_attention.py", "--seq-len", "2048"], 1500),
+    ("ring_attention_4096",
+     ["benchmarks/bench_ring_attention.py", "--seq-len", "4096"], 1500),
+    ("sp_comm", ["benchmarks/bench_sp_comm.py", "--fake-devices", "0",
+                 "--context", "1"], 1200),
+    ("dense_attn_repro",
+     ["benchmarks/repro_dense_attn.py", "--seqs", "512", "1024",
+      "--cases", "grad"], 2400),
+    ("mnist_dp", ["benchmarks/bench_mnist_dp.py"], 1200),
+    ("wide_deep", ["benchmarks/bench_wide_deep.py"], 1200),
+    ("moe_lm", ["benchmarks/bench_moe_lm.py"], 1800),
+    ("native_input", ["benchmarks/bench_native_input.py"], 1200),
+    ("resnet_native_input",
+     ["benchmarks/bench_resnet_native_input.py"], 1800),
+]
+
+
+def run_one(name: str, argv: list[str], timeout: int, out) -> bool:
+    t0 = time.time()
+    rec: dict = {"name": name, "argv": argv}
+    try:
+        proc = subprocess.run(
+            [sys.executable, *argv], cwd=ROOT, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            timeout=timeout)
+        rec["rc"] = proc.returncode
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        results = []
+        for ln in lines:
+            if ln.lstrip().startswith("{"):
+                try:
+                    results.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    pass
+        rec["results"] = results
+        if proc.returncode != 0 or not results:
+            rec["tail"] = lines[-8:]
+    except subprocess.TimeoutExpired:
+        rec["rc"] = "timeout"
+        rec["results"] = []
+    rec["secs"] = round(time.time() - t0, 1)
+    out.write(json.dumps(rec) + "\n")
+    out.flush()
+    ok = rec["rc"] == 0 and rec["results"]
+    print(f"[battery] {name}: {'ok' if ok else rec['rc']} "
+          f"({rec['secs']}s)", file=sys.stderr)
+    return bool(ok)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", nargs="+", default=None,
+                    help="subset of battery names")
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    if args.list:
+        for name, argv, t in BATTERY:
+            print(f"{name}: {' '.join(argv)} (timeout {t}s)")
+        return
+
+    todo = [b for b in BATTERY if args.only is None or b[0] in args.only]
+    if args.only:
+        missing = set(args.only) - {b[0] for b in todo}
+        if missing:
+            sys.exit(f"unknown battery names: {sorted(missing)}")
+
+    outdir = ROOT / "bench_results"
+    outdir.mkdir(exist_ok=True)
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    path = Path(args.out) if args.out else outdir / f"battery_{stamp}.jsonl"
+    n_ok = 0
+    with open(path, "a") as out:
+        out.write(json.dumps(
+            {"battery_start": stamp, "n_benches": len(todo)}) + "\n")
+        for name, argv, timeout in todo:
+            n_ok += run_one(name, argv, timeout, out)
+    print(f"[battery] {n_ok}/{len(todo)} ok -> {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
