@@ -188,9 +188,11 @@ def lm_model_flops_per_step(cfg, global_batch: int) -> float:
         make_lm_loss_fn,
     )
 
+    # tp_axis=None strips the manual f/g collectives from the trace;
+    # override_head_dim stays — a tp_local per-shard config must count its
+    # true per-shard shapes (callers then scale by n_devices in mfu_extras).
     flop_cfg = dataclasses.replace(
-        cfg, attn_impl="dense", remat=False, tp_axis=None,
-        override_head_dim=None)
+        cfg, attn_impl="dense", remat=False, tp_axis=None)
     model = Transformer(flop_cfg)
     tokens = jax.ShapeDtypeStruct((global_batch, flop_cfg.max_len), jnp.int32)
     params = jax.eval_shape(

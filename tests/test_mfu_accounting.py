@@ -1,0 +1,119 @@
+"""MFU reporting contract (benchmarks/common.py).
+
+Locks two things the on-chip numbers of record depend on:
+
+* the model-FLOP numerator — ``lm_model_flops_per_step`` must equal the
+  closed-form transformer matmul count exactly (3x forward; embedding
+  lookups are gathers, not matmuls; remat recompute and flash-kernel
+  scheduling must NOT change it), and
+* ``mfu_extras`` — mesh-size-aware peak scaling and the A100-equivalence
+  keys (a whole-mesh numerator divided by one chip's peak would inflate
+  MFU by the device count — a real review finding, kept pinned here).
+"""
+
+import pytest
+
+import benchmarks.common as common
+from benchmarks.common import lm_model_flops_per_step, mfu_extras
+
+
+def analytic_fwd_matmul_flops(cfg, batch: int) -> float:
+    """Closed-form dot_general FLOPs of one forward pass."""
+    B, S = batch, cfg.max_len
+    D, F, V, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.num_layers
+    H, hd = cfg.num_heads, cfg.d_model // cfg.num_heads
+    per_layer = (
+        2.0 * B * S * D * (3 * D)      # fused qkv projection
+        + 2.0 * B * H * S * S * hd     # scores q @ k^T
+        + 2.0 * B * H * S * S * hd     # probs @ v
+        + 2.0 * B * S * (H * hd) * D   # output projection
+        + 2.0 * B * S * D * F          # mlp up
+        + 2.0 * B * S * F * D          # mlp down
+    )
+    if cfg.num_classes is None:
+        head = 2.0 * B * S * D * V     # vocab head (tied or not — one dot)
+    else:
+        head = 2.0 * B * D * cfg.num_classes
+    return L * per_layer + head
+
+
+@pytest.fixture
+def tiny_cfg():
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        TransformerConfig,
+    )
+
+    return TransformerConfig(
+        vocab_size=512, num_layers=2, num_heads=4, d_model=64, d_ff=256,
+        max_len=128, causal=True, dtype=jnp.float32)
+
+
+def test_lm_flops_match_analytic(tiny_cfg):
+    got = lm_model_flops_per_step(tiny_cfg, 4)
+    want = 3.0 * analytic_fwd_matmul_flops(tiny_cfg, 4)
+    assert got == pytest.approx(want, rel=1e-6), (got, want)
+
+
+def test_cls_flops_match_analytic(tiny_cfg):
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_cfg, num_classes=2, causal=False)
+    got = lm_model_flops_per_step(cfg, 4)
+    want = 3.0 * analytic_fwd_matmul_flops(cfg, 4)
+    assert got == pytest.approx(want, rel=1e-6), (got, want)
+
+
+def test_numerator_invariant_to_schedule_knobs(tiny_cfg):
+    """remat / flash must not change the model-FLOP count — they change
+    scheduling, not model work."""
+    import dataclasses
+
+    base = lm_model_flops_per_step(tiny_cfg, 4)
+    for variant in (
+        dataclasses.replace(tiny_cfg, remat=True),
+        dataclasses.replace(tiny_cfg, attn_impl="flash"),
+    ):
+        assert lm_model_flops_per_step(variant, 4) == pytest.approx(
+            base, rel=1e-6)
+
+
+def test_tp_local_counts_per_shard_work(tiny_cfg):
+    """A tp_local per-shard config counts its true per-shard shapes: layer
+    matmuls halve at tp=2, the (unsharded-in-this-view) vocab head does
+    not."""
+    B = 4
+    full = lm_model_flops_per_step(tiny_cfg, B)
+    shard = lm_model_flops_per_step(tiny_cfg.tp_local(2), B)
+    head = 3.0 * 2.0 * B * tiny_cfg.max_len * tiny_cfg.d_model \
+        * tiny_cfg.vocab_size
+    assert shard - head == pytest.approx((full - head) / 2, rel=1e-6)
+
+
+def test_mfu_extras_off_accelerator(tiny_cfg):
+    """On CPU there is no peak: only the raw FLOP keys appear."""
+    out = mfu_extras(1e12, steps=10, dt=1.0)
+    assert "mfu" not in out and "vs_a100_equal_chips" not in out
+    assert out["model_tflops_per_sec"] == pytest.approx(10.0)
+
+
+def test_mfu_extras_mesh_scaling(monkeypatch):
+    monkeypatch.setattr(common, "device_peak_flops", lambda: 100e12)
+    one = mfu_extras(50e12, steps=1, dt=1.0, n_devices=1)
+    eight = mfu_extras(8 * 50e12, steps=1, dt=1.0, n_devices=8)
+    # same per-chip utilization either way
+    assert one["mfu"] == pytest.approx(0.5)
+    assert eight["mfu"] == pytest.approx(0.5)
+    assert eight["peak_tflops"] == pytest.approx(800.0)
+
+
+def test_mfu_extras_a100_equivalence(monkeypatch):
+    monkeypatch.setattr(common, "device_peak_flops", lambda: 197e12)
+    # 37% of one A100 = 115.44 TF/s; we achieve 115.44 TF/s -> exactly 1.0x
+    rate = 0.37 * common.A100_BF16_PEAK
+    out = mfu_extras(rate, steps=7, dt=7.0, n_devices=1)
+    assert out["vs_a100_equal_chips"] == pytest.approx(1.0, rel=1e-3)
+    assert out["a100_mfu_assumed"] == 0.37
+    off = mfu_extras(rate, steps=7, dt=7.0, n_devices=1, a100_mfu=None)
+    assert "vs_a100_equal_chips" not in off
