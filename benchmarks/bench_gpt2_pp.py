@@ -51,6 +51,12 @@ def main() -> None:
                     help="TP degree INSIDE each pipeline stage (Megatron "
                          "f/g inside shard_map) — dp x tp x pp in one "
                          "program when combined with --pipe and data fill")
+    ap.add_argument("--steps-per-call", type=int, default=1,
+                    help="optimizer steps per compiled dispatch (lax.scan "
+                         "inside the program; amortizes tunnel launch "
+                         "latency). >1 is an A/B knob, echoed in the JSON "
+                         "line so it can't be mistaken for the judged "
+                         "config")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -92,7 +98,8 @@ def main() -> None:
     params = pp.init_params(jax.random.PRNGKey(0))
     tx = optax.adam(3e-4)
     opt_state = pp.init_opt_state(tx, params)
-    step = pp.make_train_step(tx, params)
+    step = pp.make_train_step(tx, params,
+                              steps_per_call=args.steps_per_call)
 
     global_batch = args.microbatches * args.microbatch_size * sizes["data"]
     r = np.random.RandomState(0)
@@ -106,10 +113,14 @@ def main() -> None:
 
     dt, _ = time_steps(step2, (opt_state, params), tokens, steps=args.steps)
 
+    opt_steps = args.steps * args.steps_per_call
+    extra = {"steps_per_call": args.steps_per_call} \
+        if args.steps_per_call > 1 else {}
     report("gpt2_124m_pipeline_throughput",
-           global_batch * cfg.max_len * args.steps / dt, "tokens/sec",
+           global_batch * cfg.max_len * opt_steps / dt, "tokens/sec",
            **mfu_extras(lm_model_flops_per_step(cfg, global_batch),
-                        args.steps, dt, n_devices=mesh.devices.size))
+                        opt_steps, dt, n_devices=mesh.devices.size),
+           **extra)
 
 
 if __name__ == "__main__":

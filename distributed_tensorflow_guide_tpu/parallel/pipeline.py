@@ -1315,10 +1315,30 @@ class PipelinedLM:
 
     # -- compiled step --------------------------------------------------------
     def make_train_step(self, tx: optax.GradientTransformation, params,
-                        *, donate: bool = True):
+                        *, donate: bool = True, steps_per_call: int = 1,
+                        stacked_batch: bool = False):
         """``(opt_state, params, batch{tokens:(B,S)}) -> (opt_state, params,
         metrics)`` — B = n_data * num_microbatches * microbatch_size.
-        ``params`` is used only to derive optimizer-state specs."""
+        ``params`` is used only to derive optimizer-state specs.
+
+        ``steps_per_call > 1`` runs that many optimizer steps inside ONE
+        compiled program (``lax.scan`` around the whole pipeline schedule) —
+        the same dispatch-amortization knob as
+        :meth:`DataParallel._compile_step`: on a remote-attached chip each
+        executable launch costs milliseconds of tunnel latency, and a
+        pipeline step is ONE launch regardless of its microbatch count, so
+        K inner steps cut per-step launch overhead K-fold. With
+        ``stacked_batch`` the tokens carry a leading ``steps_per_call``
+        axis (one batch slice per inner step — the real-training mode);
+        otherwise the same tokens are re-used every inner step (synthetic
+        benchmarking mode). Metrics are the LAST inner step's."""
+        if steps_per_call < 1:
+            raise ValueError(
+                f"steps_per_call must be >= 1, got {steps_per_call}")
+        if stacked_batch and steps_per_call == 1:
+            raise ValueError(
+                "stacked_batch requires steps_per_call > 1 (a stacked "
+                "batch's leading axis is consumed one slice per inner step)")
         M = self.num_microbatches
         opt_specs = self.opt_state_specs(tx, params)
 
@@ -1353,10 +1373,34 @@ class PipelinedLM:
             params = optax.apply_updates(params, updates)
             return opt_state, params, {"loss": loss}
 
+        if steps_per_call == 1:
+            body = sm_step
+            tokens_spec = P("data")
+        else:
+            def body(opt_state, params, tokens):
+                if stacked_batch and tokens.shape[0] != steps_per_call:
+                    raise ValueError(
+                        f"stacked tokens leading axis {tokens.shape[0]} != "
+                        f"steps_per_call={steps_per_call}; the scan would "
+                        "silently run a different number of optimizer steps")
+
+                def inner(carry, xs):
+                    o, p = carry
+                    o, p, m = sm_step(o, p, tokens if xs is None else xs)
+                    return (o, p), m
+
+                (opt_state, params), ms = lax.scan(
+                    inner, (opt_state, params),
+                    tokens if stacked_batch else None,
+                    length=None if stacked_batch else steps_per_call)
+                return opt_state, params, jax.tree.map(lambda x: x[-1], ms)
+
+            tokens_spec = P(None, "data") if stacked_batch else P("data")
+
         sharded = jax.shard_map(
-            sm_step,
+            body,
             mesh=self.mesh,
-            in_specs=(opt_specs, self.param_specs(), P("data")),
+            in_specs=(opt_specs, self.param_specs(), tokens_spec),
             out_specs=(opt_specs, self.param_specs(), P()),
             check_vma=False,
         )

@@ -458,3 +458,56 @@ def test_remat_parity_across_schedules(schedule, n_pipe, v):
     np.testing.assert_allclose(l0, l1, rtol=1e-6)
     for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1), strict=True):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_steps_per_call_trajectory_parity():
+    """K optimizer steps inside one compiled call (lax.scan around the whole
+    pipeline schedule) must land on exactly the trajectory of K separate
+    calls — both the synthetic re-use mode and the stacked real-data mode."""
+    mesh = build_mesh(MeshSpec(data=2, pipe=4, model=1))
+    pp = PipelinedLM(mesh, CFG, num_microbatches=4)
+    tx = optax.adam(3e-3)
+    K = 3
+
+    def run(steps_per_call, stacked, tokens, n_calls):
+        params = pp.init_params(jax.random.PRNGKey(1))
+        opt_state = pp.init_opt_state(tx, params)
+        step = pp.make_train_step(tx, params, donate=False,
+                                  steps_per_call=steps_per_call,
+                                  stacked_batch=stacked)
+        for i in range(n_calls):
+            b = tokens[i] if not stacked and tokens.ndim == 3 else tokens
+            opt_state, params, m = step(opt_state, params, b)
+        return params, float(m["loss"])
+
+    # synthetic mode: same batch every inner step
+    flat = _tokens(16, seed=1)
+    p_multi, _ = run(K, False, flat, 1)
+    p_loop, _ = run(1, False, np.stack([flat] * K), K)
+    for a, b in zip(jax.tree.leaves(p_multi), jax.tree.leaves(p_loop)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+    # stacked mode: one batch slice per inner step
+    stack = np.stack([_tokens(16, seed=s) for s in range(K)])
+    p_multi, _ = run(K, True, stack, 1)
+    p_loop, _ = run(1, False, stack, K)
+    for a, b in zip(jax.tree.leaves(p_multi), jax.tree.leaves(p_loop)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_steps_per_call_validation():
+    mesh = build_mesh(MeshSpec(data=-1, pipe=2, model=1))
+    cfg = CFG
+    pp = PipelinedLM(mesh, cfg, num_microbatches=2)
+    tx = optax.adam(1e-3)
+    params = pp.init_params(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="steps_per_call"):
+        pp.make_train_step(tx, params, steps_per_call=0)
+    with pytest.raises(ValueError, match="stacked_batch"):
+        pp.make_train_step(tx, params, stacked_batch=True)
+    step = pp.make_train_step(tx, params, donate=False, steps_per_call=2,
+                              stacked_batch=True)
+    opt_state = pp.init_opt_state(tx, params)
+    bad = np.stack([_tokens(8)] * 3)  # leading axis 3 != steps_per_call 2
+    with pytest.raises(ValueError, match="leading axis"):
+        step(opt_state, params, bad)
