@@ -24,6 +24,8 @@ from distributed_tensorflow_guide_tpu.data.synthetic import (  # noqa: F401
 from distributed_tensorflow_guide_tpu.data.tokenizer import (  # noqa: F401
     ByteBPETokenizer,
     ByteTokenizer,
+    import_labeled_text,
     import_text,
+    labeled_text_fields,
     text_fields,
 )

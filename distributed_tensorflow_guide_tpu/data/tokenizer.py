@@ -202,6 +202,72 @@ def text_fields(seq_len: int) -> list[Field]:
     return make_fields({"tokens": (np.int32, (seq_len,))})
 
 
+def labeled_text_fields(seq_len: int) -> list[Field]:
+    """Record layout for classification configs (BERT/GLUE, config 3): one
+    fixed-length int32 token row + an int32 label per record."""
+    return make_fields({"tokens": (np.int32, (seq_len,)),
+                        "label": (np.int32, ())})
+
+
+def import_labeled_text(tsv: str | Path, out: str | Path, tokenizer,
+                        seq_len: int, *, chunk_records: int = 4096) -> int:
+    """Pack a ``label<TAB>text`` file into fixed-length classification
+    records (the GLUE-style input path for config 3).
+
+    Each line becomes one record: the text's tokens truncated to
+    ``seq_len`` and right-padded with EOS (the byte-level vocab has no
+    dedicated pad id, and padding-vs-content is recoverable — content
+    never contains EOS). Blank lines are skipped; a malformed line (no
+    tab, non-integer label) raises with its line number — silently
+    dropping examples would skew a benchmarked accuracy. Written through
+    ``write_records(append=True)`` in ``chunk_records`` chunks, temp-file +
+    atomic-replace like :func:`import_text`. Returns records written.
+    """
+    tsv, out = Path(tsv), Path(out)
+    fields = labeled_text_fields(seq_len)
+    tmp = out.with_suffix(out.suffix + f".tmp{os.getpid()}")
+    eos = tokenizer.eos_id
+    toks = np.full((chunk_records, seq_len), eos, np.int32)
+    labs = np.zeros((chunk_records,), np.int32)
+    n, fill = 0, 0
+    try:
+        with open(tsv, "rb") as fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.rstrip(b"\r\n")
+                if not line:
+                    continue
+                label, tab, text = line.partition(b"\t")
+                try:
+                    labs[fill] = int(label)
+                except ValueError:
+                    raise ValueError(
+                        f"{tsv}:{lineno}: expected 'label<TAB>text', got "
+                        f"{line[:80]!r}") from None
+                if not tab:
+                    raise ValueError(
+                        f"{tsv}:{lineno}: no tab separator in "
+                        f"{line[:80]!r}")
+                ids = tokenizer.encode(text)[:seq_len]
+                toks[fill, :len(ids)] = ids
+                toks[fill, len(ids):] = eos
+                fill += 1
+                if fill == chunk_records:
+                    write_records(tmp, {"tokens": toks, "label": labs},
+                                  fields, append=n > 0)
+                    n += fill
+                    fill = 0
+        if fill:
+            write_records(tmp, {"tokens": toks[:fill], "label": labs[:fill]},
+                          fields, append=n > 0)
+            n += fill
+        if n == 0:
+            raise ValueError(f"{tsv}: no examples (empty file?)")
+        os.replace(tmp, out)
+    finally:
+        tmp.unlink(missing_ok=True)
+    return n
+
+
 def import_text(corpus: str | Path, out: str | Path, tokenizer,
                 seq_len: int, *, chunk_records: int = 4096) -> int:
     """Tokenize ``corpus`` and pack into ``out`` as fixed-length records.

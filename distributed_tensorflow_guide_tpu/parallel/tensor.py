@@ -154,3 +154,30 @@ class TensorParallel:
         # memory_analysis) — the wrapper itself is a plain function
         step_in_mesh.jitted = jitted
         return step_in_mesh
+
+    def make_eval_step(self, metric_fn, state_shardings: Any):
+        """``(state, batch) -> metrics`` — the no-grad half for
+        :class:`~distributed_tensorflow_guide_tpu.train.evaluation.Evaluator`:
+        same shardings and logical-rule context as the train step, GSPMD
+        collectives only, state untouched. ``metric_fn(params, batch) ->
+        {name: scalar}`` (e.g. built from
+        ``models.transformer.make_cls_loss_fn`` by dropping the grad)."""
+        batch_sharding = NamedSharding(self.mesh, P("data"))
+        param_shardings = state_shardings.params
+
+        def step(params, batch):
+            with nn.logical_axis_rules(self.rules), activation_mesh(self.mesh):
+                return metric_fn(params, batch)
+
+        jitted = jax.jit(
+            step,
+            in_shardings=(param_shardings, batch_sharding),
+            out_shardings=NamedSharding(self.mesh, P()),
+        )
+
+        def step_in_mesh(state, batch):
+            with self.mesh:
+                return jitted(state.params, batch)
+
+        step_in_mesh.jitted = jitted
+        return step_in_mesh

@@ -175,3 +175,47 @@ def test_eval_during_training_improves(mesh8):
     first, last = hook.history[0][1], hook.history[-1][1]
     assert last["loss"] < first["loss"]
     assert last["accuracy"] >= first["accuracy"]
+
+
+def test_tp_eval_matches_unsharded():
+    """TensorParallel.make_eval_step (pjit, model-sharded params) must equal
+    the plain single-device metric on identical params/batch."""
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        make_cls_loss_fn,
+    )
+    from distributed_tensorflow_guide_tpu.parallel.tensor import TensorParallel
+
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=2, num_heads=4, d_model=32, d_ff=64,
+        max_len=16, causal=False, num_classes=2, dtype=jnp.float32)
+    mesh = build_mesh(MeshSpec(data=2, model=4))
+    model = Transformer(cfg)
+    tp = TensorParallel(mesh)
+    params, shardings = tp.init_params(
+        model, jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_len), jnp.int32))
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.1))
+    st_shard = tp.state_shardings(state, shardings)
+    state = jax.device_put(state, st_shard)
+
+    cls_loss = make_cls_loss_fn(model)
+
+    def metric_fn(p, b):
+        loss, mets = cls_loss(p, b)
+        return {"loss": loss, **mets}
+
+    ev_step = tp.make_eval_step(metric_fn, st_shard)
+    rng = np.random.RandomState(3)
+    batch = {
+        "tokens": rng.randint(0, 64, (16, cfg.max_len)).astype(np.int32),
+        "label": rng.randint(0, 2, 16).astype(np.int32),
+    }
+    got = ev_step(state, batch)
+    host_params = jax.device_get(state.params)
+    want = metric_fn(host_params, batch)
+    for k in want:
+        np.testing.assert_allclose(
+            float(got[k]), float(want[k]), rtol=1e-5, atol=1e-6)

@@ -143,3 +143,89 @@ def test_import_text_too_small_raises(bpe, tmp_path):
     corpus.write_text("ab")
     with pytest.raises(ValueError, match="seq_len"):
         import_text(corpus, tmp_path / "t.records", bpe, 4096)
+
+
+# -- labeled text (classification records, config 3) --------------------------
+
+
+@pytest.fixture(scope="module")
+def labeled_tsv(tmp_path_factory):
+    p = tmp_path_factory.mktemp("labeled") / "data.tsv"
+    lines = [
+        "1\tthe film was great and warm",
+        "0\tbleak and broken plot",
+        "1\tsuperb honest delightful scenes",
+        "0\tsour awful ending",
+        "1\tcrisp bright dialogue",
+        "0\tmurky shallow pacing",
+    ]
+    p.write_text("\n".join(lines) + "\n")
+    return p, lines
+
+
+def test_import_labeled_text_roundtrip(bpe, labeled_tsv, tmp_path):
+    from distributed_tensorflow_guide_tpu.data.tokenizer import (
+        import_labeled_text,
+        labeled_text_fields,
+    )
+
+    tsv, lines = labeled_tsv
+    seq = 24
+    rec = tmp_path / "d.records"
+    n = import_labeled_text(tsv, rec, bpe, seq)
+    assert n == len(lines)
+    fields = labeled_text_fields(seq)
+    ld = PyRecordLoader(rec, fields, batch_size=n, shuffle=False)
+    b = ld.next_batch()
+    for i, line in enumerate(lines):
+        label, text = line.split("\t", 1)
+        assert b["label"][i] == int(label)
+        ids = bpe.encode(text.encode())[:seq]
+        want = ids + [bpe.eos_id] * (seq - len(ids))
+        np.testing.assert_array_equal(b["tokens"][i], want)
+
+
+def test_import_labeled_text_truncates_long_lines(bpe, tmp_path):
+    from distributed_tensorflow_guide_tpu.data.tokenizer import (
+        import_labeled_text,
+        labeled_text_fields,
+    )
+
+    tsv = tmp_path / "long.tsv"
+    tsv.write_text("0\t" + "word " * 500 + "\n")
+    rec = tmp_path / "long.records"
+    seq = 16
+    assert import_labeled_text(tsv, rec, bpe, seq) == 1
+    ld = PyRecordLoader(rec, labeled_text_fields(seq), batch_size=1,
+                        shuffle=False)
+    b = ld.next_batch()
+    assert b["tokens"].shape == (1, seq)
+    np.testing.assert_array_equal(
+        b["tokens"][0], bpe.encode(("word " * 500).encode())[:seq])
+
+
+def test_import_labeled_text_rejects_malformed(bpe, tmp_path):
+    from distributed_tensorflow_guide_tpu.data.tokenizer import (
+        import_labeled_text,
+    )
+
+    for bad, match in [("no tab here", "label<TAB>text"),
+                       ("x\ttext", "label<TAB>text"),
+                       ("", "no examples")]:
+        tsv = tmp_path / "bad.tsv"
+        tsv.write_text(bad + "\n" if bad else "")
+        with pytest.raises(ValueError, match=match):
+            import_labeled_text(tsv, tmp_path / "bad.records", bpe, 8)
+
+
+def test_import_labeled_text_chunked_append(bpe, labeled_tsv, tmp_path):
+    """Chunked writes must concatenate to the same file as one shot."""
+    from distributed_tensorflow_guide_tpu.data.tokenizer import (
+        import_labeled_text,
+    )
+
+    tsv, lines = labeled_tsv
+    a, b = tmp_path / "a.records", tmp_path / "b.records"
+    import_labeled_text(tsv, a, bpe, 24, chunk_records=2)
+    import_labeled_text(tsv, b, bpe, 24)
+    assert a.read_bytes() == b.read_bytes()
