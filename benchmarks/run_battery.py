@@ -36,6 +36,9 @@ BATTERY: list[tuple[str, list[str], int]] = [
      ["benchmarks/bench_gpt2_pp.py", "--virtual-chunks", "2"], 1800),
     ("gpt2_pp_gpipe",
      ["benchmarks/bench_gpt2_pp.py", "--schedule", "gpipe"], 1800),
+    ("gpt2_pp_1f1b_spc8",
+     ["benchmarks/bench_gpt2_pp.py", "--steps-per-call", "8",
+      "--steps", "8"], 1800),
     ("gpt2_flash_seq1024",
      ["benchmarks/bench_gpt2_pp.py", "--seq-len", "1024",
       "--microbatch-size", "1"], 1800),
