@@ -39,6 +39,8 @@ BATTERY: list[tuple[str, list[str], int]] = [
     ("gpt2_pp_1f1b_spc8",
      ["benchmarks/bench_gpt2_pp.py", "--steps-per-call", "8",
       "--steps", "8"], 1800),
+    ("gpt2_pp_1f1b_noremat",
+     ["benchmarks/bench_gpt2_pp.py", "--no-remat"], 1800),
     ("gpt2_flash_seq1024",
      ["benchmarks/bench_gpt2_pp.py", "--seq-len", "1024",
       "--microbatch-size", "1"], 1800),
