@@ -1,0 +1,110 @@
+"""Autoregressive generation — the serving half of the LM family.
+
+The reference is a training tutorial and has no inference path at all;
+this is capability the TPU build adds on top of parity. TPU-first shape:
+
+* **Static shapes everywhere.** The KV cache is a fixed (B, max_len, H,
+  hd) buffer per layer (flax "cache" collection, written with
+  ``lax.dynamic_update_slice``); the decode loop is ONE ``lax.scan`` whose
+  body processes exactly one token — the whole generate call compiles to
+  a single XLA program, no per-token dispatch, no retraces as the
+  sequence grows.
+* **One attention code path for prefill and decode**: a chunk of C tokens
+  attends to the full cache under ``key_pos <= q_pos`` (masking both
+  causality and not-yet-written slots), so the prompt is ingested in one
+  forward pass (C = prompt length) and decode steps reuse the same module
+  with C = 1 (models/transformer.py ``_decode_attend``).
+* Sampling: greedy (``temperature=0``), temperature, and top-k — all
+  branchless (top-k via ``lax.top_k`` threshold masking) so the scan body
+  stays a single fused program.
+
+Decode-mode parity with the training forward is pinned by
+tests/test_generation.py (prefill logits == full-forward logits; greedy
+decode == argmax-rescoring the growing prefix with the training model).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_tensorflow_guide_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+)
+
+
+def decode_config(cfg: TransformerConfig) -> TransformerConfig:
+    """The serving view of a training config: KV-cache attention (dense —
+    flash is a long-context *training* kernel; decode chunks are 1 token),
+    no remat (nothing to rematerialize without a backward pass)."""
+    return dataclasses.replace(cfg, decode=True, attn_impl="dense",
+                               remat=False)
+
+
+def init_cache(cfg: TransformerConfig, params, batch_size: int):
+    """Allocate the fixed-size KV cache for ``batch_size`` sequences."""
+    model = Transformer(decode_config(cfg))
+    variables = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0),
+        jnp.zeros((batch_size, 1), jnp.int32), 0)
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                         variables["cache"])
+    del params  # shape/dtype only — kept in the signature for call-site symmetry
+    return cache
+
+
+def _sample(logits, rng, temperature: float, top_k: int | None):
+    """(B, V) logits -> (B,) int32 token ids. Branchless; greedy when
+    temperature == 0 (exact argmax, not a limit)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, -1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+
+def make_generate_fn(cfg: TransformerConfig, *, max_new_tokens: int,
+                     temperature: float = 1.0, top_k: int | None = None):
+    """Build a jitted ``(params, prompt (B, P) int32, rng) -> (B, P + N)``
+    generator. Compiles once per (B, P) shape; P + max_new_tokens must fit
+    ``cfg.max_len`` (checked at trace time)."""
+    dcfg = decode_config(cfg)
+    model = Transformer(dcfg)
+    sample = partial(_sample, temperature=temperature, top_k=top_k)
+
+    @jax.jit
+    def generate(params, prompt, rng):
+        B, P = prompt.shape
+        if P + max_new_tokens > dcfg.max_len:
+            raise ValueError(
+                f"prompt {P} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_len {dcfg.max_len}")
+        cache = init_cache(cfg, params, B)
+        # prefill: the whole prompt in one forward pass, cache filled
+        logits, vs = model.apply({"params": params, "cache": cache},
+                                 prompt, 0, mutable=["cache"])
+        rng, sub = jax.random.split(rng)
+        tok = sample(logits[:, -1], sub)
+
+        def body(carry, _):
+            cache, tok, idx, rng = carry
+            logits, vs = model.apply({"params": params, "cache": cache},
+                                     tok[:, None], idx, mutable=["cache"])
+            rng, sub = jax.random.split(rng)
+            nxt = sample(logits[:, -1], sub)
+            return (vs["cache"], nxt, idx + 1, rng), tok
+
+        (_, last, _, _), toks = lax.scan(
+            body, (vs["cache"], tok, jnp.int32(P), rng), None,
+            length=max_new_tokens - 1)
+        new = jnp.concatenate([toks.T, last[:, None]], axis=1)  # (B, N)
+        return jnp.concatenate([prompt, new], axis=1)
+
+    return generate

@@ -26,6 +26,7 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from distributed_tensorflow_guide_tpu.collectives import (
     tp_allreduce,
@@ -74,6 +75,13 @@ class TransformerConfig:
     # strategy), where XLA inserts the collectives itself.
     tp_axis: str | None = None
     override_head_dim: int | None = None
+    # Autoregressive serving mode (models/generation.py): attention keeps a
+    # (B, max_len, H, hd) KV cache in the flax "cache" collection and the
+    # caller passes the write ``index``; a call processes an arbitrary
+    # chunk (the whole prompt at prefill, 1 token per decode step) with
+    # static shapes throughout — the lax.scan decode loop compiles once.
+    # False (default) leaves the training path byte-identical.
+    decode: bool = False
 
     def __post_init__(self):
         if self.attn_impl not in ("auto", "dense", "flash"):
@@ -156,7 +164,7 @@ class MultiHeadAttention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:  # (B, S, D)
+    def __call__(self, x: jax.Array, index=None) -> jax.Array:  # (B, S, D)
         cfg = self.cfg
         h, hd = cfg.num_heads, cfg.head_dim
         if cfg.tp_axis:  # Megatron f: identity fwd, psum bwd (see tp_axis doc)
@@ -180,7 +188,9 @@ class MultiHeadAttention(nn.Module):
         k = _constrain(k, ("batch", "seq_inner", "heads", "kv"))
         v = _constrain(v, ("batch", "seq_inner", "heads", "kv"))
 
-        if cfg.resolve_attn_impl(x.shape[1]) == "flash":
+        if cfg.decode:
+            out = self._decode_attend(q, k, v, index)
+        elif cfg.resolve_attn_impl(x.shape[1]) == "flash":
             from distributed_tensorflow_guide_tpu.ops.flash_attention import (
                 flash_attention,
             )
@@ -211,6 +221,38 @@ class MultiHeadAttention(nn.Module):
         if cfg.tp_axis:  # Megatron g: psum fwd (row-parallel proj), id bwd
             out = tp_allreduce(out, cfg.tp_axis)
         return out
+
+    def _decode_attend(self, q, k, v, index):
+        """KV-cache incremental attention over a (B, C, H, hd) chunk.
+
+        Writes the chunk's k/v at cache positions [index, index+C) and
+        attends q against the full fixed-size cache under the mask
+        ``key_pos <= q_pos`` — which simultaneously enforces causality
+        within the chunk AND hides every not-yet-written cache slot (a
+        slot is written only once its position has been reached), so
+        one code path serves prefill (C = prompt length) and decode
+        (C = 1) with fully static shapes.
+        """
+        cfg = self.cfg
+        if index is None:
+            raise ValueError("cfg.decode=True requires the write index")
+        B, C, h, hd = q.shape
+        ck = self.variable("cache", "cached_key", jnp.zeros,
+                           (B, cfg.max_len, h, hd), cfg.dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros,
+                           (B, cfg.max_len, h, hd), cfg.dtype)
+        ck.value = lax.dynamic_update_slice(ck.value, k, (0, index, 0, 0))
+        cv.value = lax.dynamic_update_slice(cv.value, v, (0, index, 0, 0))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) / jnp.sqrt(
+            hd).astype(cfg.dtype)
+        q_pos = index + jnp.arange(C)
+        k_pos = jnp.arange(cfg.max_len)
+        mask = k_pos[None, :] <= q_pos[:, None]  # (C, max_len)
+        scores = jnp.where(mask[None, None], scores,
+                           jnp.finfo(cfg.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(
+            cfg.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
 
 
 class MLP(nn.Module):
@@ -250,10 +292,10 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, index=None) -> jax.Array:
         cfg = self.cfg
         x = x + MultiHeadAttention(cfg, name="attn")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+            nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x), index
         )
         x = x + MLP(cfg, name="mlp")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
@@ -268,8 +310,13 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:  # (B, S) int32
+    def __call__(self, tokens: jax.Array, index=None) -> jax.Array:
+        # tokens (B, S) int32; ``index`` only in cfg.decode mode: the
+        # absolute position of tokens[:, 0] (prefill passes 0, the decode
+        # loop passes the running length)
         cfg = self.cfg
+        if cfg.decode and index is None:
+            raise ValueError("cfg.decode=True requires the position index")
         x = nn.Embed(
             cfg.vocab_size,
             cfg.d_model,
@@ -277,13 +324,16 @@ class Transformer(nn.Module):
             embedding_init=_dense_init("vocab", "embed"),
             name="tok_emb",
         )(tokens)
+        positions = jnp.arange(tokens.shape[1])[None, :]
+        if cfg.decode:
+            positions = positions + index
         pos = nn.Embed(
             cfg.max_len,
             cfg.d_model,
             dtype=cfg.dtype,
             embedding_init=_dense_init("seq", "embed"),
             name="pos_emb",
-        )(jnp.arange(tokens.shape[1])[None, :])
+        )(positions)
         x = x + pos
         x = _constrain(x, ("batch", "seq", "embed"))
 
@@ -291,7 +341,7 @@ class Transformer(nn.Module):
         if cfg.remat:
             block = nn.remat(Block, prevent_cse=False)
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"block_{i}")(x)
+            x = block(cfg, name=f"block_{i}")(x, index)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
 
         if cfg.num_classes is not None:
