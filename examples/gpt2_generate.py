@@ -1,0 +1,137 @@
+"""End-to-end LM story: corpus -> BPE tokenizer -> token records -> sync-DP
+training -> KV-cache generation -> decoded text.
+
+The serving-side companion to examples/gpt2_pipeline.py (training-side).
+No reference equivalent: the guide stops at training loss. The generate
+call is ONE compiled XLA program (prefill forward + lax.scan decode loop,
+static shapes, per-layer KV cache) — see models/generation.py.
+
+    python examples/gpt2_generate.py --fake-devices 8 --steps 300 \\
+        --prompt "the quick brown"
+"""
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+# A tiny deterministic corpus the model can memorize in a few hundred
+# steps — the point is exercising the full loop, not language modeling.
+DEMO_CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "pack my box with five dozen liquor jugs. "
+    "how vexingly quick daft zebras jump. "
+) * 120
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--global-batch", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--data", default=None, metavar="CORPUS",
+                    help="text file (default: built-in demo corpus)")
+    ap.add_argument("--bpe-vocab", type=int, default=384)
+    ap.add_argument("--prompt", default="the quick brown")
+    ap.add_argument("--max-new", type=int, default=24)
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="0 = greedy")
+    ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument("--fake-devices", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.fake_devices:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.fake_devices:
+        # env + config both needed: the axon plugin re-asserts during import
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.fake_devices)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax.training import train_state
+
+    from distributed_tensorflow_guide_tpu.core.dist import initialize
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.data.native_loader import (
+        open_record_loader,
+    )
+    from distributed_tensorflow_guide_tpu.data.tokenizer import (
+        ByteBPETokenizer,
+        import_text,
+        text_fields,
+    )
+    from distributed_tensorflow_guide_tpu.models.generation import (
+        make_generate_fn,
+    )
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        make_lm_loss_fn,
+    )
+    from distributed_tensorflow_guide_tpu.parallel.data_parallel import (
+        DataParallel,
+    )
+
+    initialize()
+    mesh = build_mesh(MeshSpec(data=-1))
+    dp = DataParallel(mesh)
+
+    # corpus -> tokenizer -> records -> native loader
+    work = Path(args.data) if args.data else None
+    corpus_bytes = (work.read_bytes() if work
+                    else DEMO_CORPUS.encode())
+    tokenizer = ByteBPETokenizer.train(corpus_bytes,
+                                       vocab_size=args.bpe_vocab)
+    rec = Path(os.environ.get("TMPDIR", "/tmp")) / "gpt2_generate.records"
+    tmp_corpus = rec.with_suffix(".txt")
+    tmp_corpus.write_bytes(corpus_bytes)
+    n = import_text(tmp_corpus, rec, tokenizer, args.seq_len)
+    loader = open_record_loader(rec, text_fields(args.seq_len),
+                                args.global_batch, seed=0)
+    print(f"corpus: {len(corpus_bytes)} bytes -> {n} records, "
+          f"vocab {tokenizer.vocab_size}")
+
+    cfg = TransformerConfig(
+        vocab_size=-(-tokenizer.vocab_size // 128) * 128,
+        num_layers=args.layers, num_heads=args.heads,
+        d_model=args.d_model, d_ff=4 * args.d_model,
+        max_len=args.seq_len, causal=True, dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, cfg.max_len), jnp.int32))["params"]
+    state = dp.replicate(train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.adam(args.lr)))
+    step = dp.make_train_step(make_lm_loss_fn(model))
+
+    for i in range(args.steps):
+        batch = dp.shard_batch(loader.next_batch())
+        state, m = step(state, batch)
+        if i % 50 == 0 or i == args.steps - 1:
+            print(f"step {i}: loss={float(m['loss']):.4f} "
+                  f"ppl={float(m['perplexity']):.1f}")
+
+    # generate: one compiled program; params already replicated on-mesh
+    gen = make_generate_fn(cfg, max_new_tokens=args.max_new,
+                           temperature=args.temperature, top_k=args.top_k)
+    prompt_ids = np.asarray([tokenizer.encode(args.prompt.encode())],
+                            np.int32)
+    out = np.asarray(gen(jax.device_get(state.params), prompt_ids,
+                         jax.random.PRNGKey(0)))
+    text = tokenizer.decode(out[0].tolist())
+    print(f"prompt : {args.prompt!r}")
+    print(f"output : {text!r}")
+    print("generate ok")
+
+
+if __name__ == "__main__":
+    main()

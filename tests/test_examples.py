@@ -126,3 +126,20 @@ def test_bert_trains_from_labeled_text(tmp_path):
                         env=env, cwd=REPO)
     assert r2.returncode == 0, r2.stdout + r2.stderr
     assert "loaded BPE vocab" in r2.stdout, r2.stdout
+
+
+def test_gpt2_generate_example():
+    """Train-then-serve loop: corpus -> tokenizer -> records -> DP training
+    -> compiled KV-cache generation -> decoded text."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "gpt2_generate.py"),
+         "--fake-devices", "8", "--steps", "120", "--max-new", "8",
+         "--layers", "1", "--d-model", "64", "--heads", "2",
+         "--seq-len", "32"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "generate ok" in r.stdout, r.stdout
+    assert "output : 'the quick brown" in r.stdout, r.stdout
