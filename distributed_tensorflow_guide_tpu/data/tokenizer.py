@@ -202,6 +202,15 @@ def text_fields(seq_len: int) -> list[Field]:
     return make_fields({"tokens": (np.int32, (seq_len,))})
 
 
+def padded_vocab(n: int, multiple: int = 128) -> int:
+    """Model vocab for a tokenizer of ``n`` tokens: rounded up to the lane
+    multiple (MXU tiling + even vocab-parallel sharding over any model
+    axis — the standard Megatron-style padding). One definition so a
+    served model's head size can never drift from its trained
+    checkpoint's."""
+    return -(-n // multiple) * multiple
+
+
 def labeled_text_fields(seq_len: int) -> list[Field]:
     """Record layout for classification configs (BERT/GLUE, config 3): one
     fixed-length int32 token row + an int32 label per record."""

@@ -113,6 +113,7 @@ def main() -> None:
             ByteBPETokenizer,
             import_labeled_text,
             labeled_text_fields,
+            padded_vocab,
         )
 
         tsv = Path(args.data)
@@ -157,7 +158,7 @@ def main() -> None:
         # mean-of-means; the loader drops the remainder)
         eval_loader = open_record_loader(
             recs["eval"], fields, args.global_batch, seed=0)
-        vocab_size = -(-tokenizer.vocab_size // 128) * 128  # MXU/TP padding
+        vocab_size = padded_vocab(tokenizer.vocab_size)
 
     mesh = build_mesh(MeshSpec(data=-1, model=args.model_parallel))
     cfg = bert_base(num_classes=2, dtype=jnp.float32)

@@ -68,6 +68,7 @@ def main() -> None:
     from distributed_tensorflow_guide_tpu.data.tokenizer import (
         ByteBPETokenizer,
         import_text,
+        padded_vocab,
         text_fields,
     )
     from distributed_tensorflow_guide_tpu.models.generation import (
@@ -86,23 +87,29 @@ def main() -> None:
     mesh = build_mesh(MeshSpec(data=-1))
     dp = DataParallel(mesh)
 
-    # corpus -> tokenizer -> records -> native loader
-    work = Path(args.data) if args.data else None
-    corpus_bytes = (work.read_bytes() if work
-                    else DEMO_CORPUS.encode())
+    # corpus -> tokenizer -> records -> native loader. Records go to a
+    # private temp dir (concurrent runs must not clobber each other); a
+    # --data corpus is imported straight from its own path.
+    import tempfile
+
+    workdir = Path(tempfile.mkdtemp(prefix="gpt2_generate_"))
+    if args.data:
+        corpus = Path(args.data)
+    else:
+        corpus = workdir / "demo.txt"
+        corpus.write_text(DEMO_CORPUS)
+    corpus_bytes = corpus.read_bytes()
     tokenizer = ByteBPETokenizer.train(corpus_bytes,
                                        vocab_size=args.bpe_vocab)
-    rec = Path(os.environ.get("TMPDIR", "/tmp")) / "gpt2_generate.records"
-    tmp_corpus = rec.with_suffix(".txt")
-    tmp_corpus.write_bytes(corpus_bytes)
-    n = import_text(tmp_corpus, rec, tokenizer, args.seq_len)
+    rec = workdir / "corpus.records"
+    n = import_text(corpus, rec, tokenizer, args.seq_len)
     loader = open_record_loader(rec, text_fields(args.seq_len),
                                 args.global_batch, seed=0)
     print(f"corpus: {len(corpus_bytes)} bytes -> {n} records, "
           f"vocab {tokenizer.vocab_size}")
 
     cfg = TransformerConfig(
-        vocab_size=-(-tokenizer.vocab_size // 128) * 128,
+        vocab_size=padded_vocab(tokenizer.vocab_size),
         num_layers=args.layers, num_heads=args.heads,
         d_model=args.d_model, d_ff=4 * args.d_model,
         max_len=args.seq_len, causal=True, dtype=jnp.float32)
@@ -125,8 +132,7 @@ def main() -> None:
                            temperature=args.temperature, top_k=args.top_k)
     prompt_ids = np.asarray([tokenizer.encode(args.prompt.encode())],
                             np.int32)
-    out = np.asarray(gen(jax.device_get(state.params), prompt_ids,
-                         jax.random.PRNGKey(0)))
+    out = np.asarray(gen(state.params, prompt_ids, jax.random.PRNGKey(0)))
     text = tokenizer.decode(out[0].tolist())
     print(f"prompt : {args.prompt!r}")
     print(f"output : {text!r}")

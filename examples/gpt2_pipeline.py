@@ -88,6 +88,7 @@ def main() -> None:
         # native loader. Tokenization never touches the training hot path.
         from distributed_tensorflow_guide_tpu.data.tokenizer import (
             ByteBPETokenizer,
+            padded_vocab,
         )
 
         vocab_file = Path(args.data).with_suffix(".vocab.json")
@@ -105,7 +106,7 @@ def main() -> None:
         # tiling + vocab-parallel divisibility under --model-parallel);
         # an explicit larger --vocab is respected (headroom keeps later
         # checkpoints shape-compatible with a regrown vocab)
-        padded = -(-tokenizer.vocab_size // 128) * 128
+        padded = padded_vocab(tokenizer.vocab_size)
         if args.vocab > padded:
             print(f"vocab: keeping --vocab {args.vocab} "
                   f"(tokenizer needs {padded})")

@@ -142,4 +142,8 @@ def test_gpt2_generate_example():
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "generate ok" in r.stdout, r.stdout
-    assert "output : 'the quick brown" in r.stdout, r.stdout
+    # content check without pinning repr's quote style (an apostrophe in
+    # generated bytes would flip repr to double quotes)
+    out_line = next(ln for ln in r.stdout.splitlines()
+                    if ln.startswith("output :"))
+    assert "the quick brown" in out_line, r.stdout
