@@ -1406,6 +1406,49 @@ class PipelinedLM:
         )
         return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 
+    def to_serving_params(self, params) -> dict:
+        """Pipeline param tree -> the flat ``models.transformer.Transformer``
+        layout, so a pipeline-trained LM can be served by
+        ``models/generation.py`` (or fine-tuned under any other strategy).
+
+        Inverts the stage stacking of :meth:`init_params`: contiguous
+        sharding (v=1) stores layers in global order; interleaved stacking
+        stores row r = s*v + j as chunk-stage k = j*P + s, inverted here
+        with the same index map. Works on host or on-device arrays (the
+        gather is a pure indexing program); TP>1 params are global-shaped
+        and convert unchanged. Logits parity is pinned by
+        tests/test_pipeline.py::test_to_serving_params_logits_parity.
+        """
+        import numpy as np
+
+        P_, v, Lc = self.n_stages, self.virtual_chunks, self.layers_per_chunk
+        L = self.cfg.num_layers
+
+        if v == 1:
+            inv = None
+        else:
+            order = []
+            for r in range(P_ * v):
+                s, j = divmod(r, v)
+                k = j * P_ + s
+                order.extend(range(k * Lc, (k + 1) * Lc))
+            inv = np.argsort(np.asarray(order))
+
+        def unstack(x):  # (rows, Lc, ...) -> (L, ...) global layer order
+            flat = x.reshape(L, *x.shape[2:])
+            return flat if inv is None else flat[inv]
+
+        stages = jax.tree.map(unstack, params["stages"])
+        out = {
+            "tok_emb": params["embed"]["tok_emb"],
+            "pos_emb": params["embed"]["pos_emb"],
+            "ln_f": params["head"]["ln_f"],
+            "lm_head": params["head"]["lm_head"],
+        }
+        for i in range(L):
+            out[f"block_{i}"] = jax.tree.map(lambda x, i=i: x[i], stages)
+        return out
+
     def init_opt_state(self, tx, params):
         """Optimizer state materialized directly into its shard layout."""
         shardings = jax.tree.map(
