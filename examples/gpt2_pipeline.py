@@ -50,6 +50,11 @@ def main() -> None:
                          "batches. Default: random tokens.")
     ap.add_argument("--bpe-vocab", type=int, default=1024,
                     help="target BPE vocab size when training a tokenizer")
+    ap.add_argument("--generate", default=None, metavar="PROMPT",
+                    help="after training, convert the pipeline params to "
+                         "the serving layout and greedily decode from "
+                         "PROMPT (needs --data)")
+    ap.add_argument("--max-new", type=int, default=24)
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -190,6 +195,25 @@ def main() -> None:
     print(f"done: {n_params/1e6:.1f}M params over {sizes['pipe']} stages x "
           f"{sizes['data']} data shards; {kind} bubble fraction "
           f"{bubble:.2f} ({args.microbatches} microbatches)")
+
+    if args.generate is not None:
+        if tokenizer is None:
+            raise SystemExit("--generate needs --data (a trained tokenizer)")
+        # train-with-PP, serve-with-KV-cache: invert the stage stacking to
+        # the flat Transformer layout and decode (parity pinned in
+        # tests/test_pipeline.py::test_to_serving_params_logits_parity)
+        import dataclasses
+
+        from distributed_tensorflow_guide_tpu.models.generation import (
+            make_generate_fn,
+        )
+
+        serving = pp.to_serving_params(jax.device_get(params))
+        gen = make_generate_fn(dataclasses.replace(cfg, remat=False),
+                               max_new_tokens=args.max_new, temperature=0.0)
+        ids = np.asarray([tokenizer.encode(args.generate.encode())], np.int32)
+        out = np.asarray(gen(serving, ids, jax.random.PRNGKey(0)))
+        print("generated:", tokenizer.decode(out[0].tolist()))
 
 
 if __name__ == "__main__":
