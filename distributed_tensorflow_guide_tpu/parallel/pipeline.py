@@ -1406,6 +1406,35 @@ class PipelinedLM:
         )
         return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 
+    def make_eval_step(self):
+        """``(params, tokens) -> {loss, perplexity}`` — the no-grad half for
+        :class:`~distributed_tensorflow_guide_tpu.train.evaluation.Evaluator`
+        (pass the param tree as the evaluator's ``state``). Forward-only
+        GPipe traversal (a backward schedule is a training concern; the
+        forward loss is schedule-independent), psum'd across stages,
+        pmean'd across data shards."""
+        M = self.num_microbatches
+
+        def sm_eval(params, tokens):
+            mbs = tokens.reshape(M, tokens.shape[0] // M, tokens.shape[1])
+            if self.virtual_chunks > 1:
+                local_loss = self._pipeline_loss_interleaved(params, mbs)
+            else:
+                local_loss = self._pipeline_loss(params, mbs)
+            loss = cc.psum(local_loss, "pipe")
+            if self.n_data > 1:
+                loss = cc.pmean(loss, "data")
+            return {"loss": loss, "perplexity": jnp.exp(loss)}
+
+        sharded = jax.shard_map(
+            sm_eval,
+            mesh=self.mesh,
+            in_specs=(self.param_specs(), P("data")),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(sharded)
+
     def to_serving_params(self, params) -> dict:
         """Pipeline param tree -> the flat ``models.transformer.Transformer``
         layout, so a pipeline-trained LM can be served by

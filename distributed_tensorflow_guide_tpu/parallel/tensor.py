@@ -114,11 +114,27 @@ class TensorParallel:
 
     # -- compiled steps -------------------------------------------------------
     def make_train_step(self, loss_fn: LossFn, state_shardings: Any,
-                        *, donate: bool = True):
+                        *, donate: bool = True, steps_per_call: int = 1,
+                        stacked_batch: bool = False):
         """jit the step with explicit in/out shardings; GSPMD derives the
         collectives (the reference's gRPC push/pull has no analogue here —
-        nothing moves except the math's own allreduces)."""
-        batch_sharding = NamedSharding(self.mesh, P("data"))
+        nothing moves except the math's own allreduces).
+
+        ``steps_per_call`` / ``stacked_batch``: the same dispatch-
+        amortization knob as :meth:`DataParallel._compile_step` and
+        :meth:`PipelinedLM.make_train_step` — K optimizer steps inside one
+        compiled program via ``lax.scan``; stacked mode consumes a leading
+        ``steps_per_call`` batch axis, otherwise the same batch repeats.
+        Metrics are the LAST inner step's."""
+        if steps_per_call < 1:
+            raise ValueError(
+                f"steps_per_call must be >= 1, got {steps_per_call}")
+        if stacked_batch and steps_per_call == 1:
+            raise ValueError(
+                "stacked_batch requires steps_per_call > 1 (a stacked "
+                "batch's leading axis is consumed one slice per inner step)")
+        batch_sharding = NamedSharding(
+            self.mesh, P(None, "data") if stacked_batch else P("data"))
 
         def step(state, batch):
             # activation_mesh makes the model's logical constraints binding
@@ -132,8 +148,32 @@ class TensorParallel:
             state = state.apply_gradients(grads=grads)
             return state, {"loss": loss, **mets}
 
+        if steps_per_call == 1:
+            body = step
+        else:
+            from jax import lax
+
+            def body(state, batch):
+                if stacked_batch:
+                    lead = {jax.tree.leaves(batch)[0].shape[0]}
+                    if lead != {steps_per_call}:
+                        raise ValueError(
+                            f"stacked batch leading axis {lead} != "
+                            f"steps_per_call={steps_per_call}; the scan "
+                            "would silently run a different number of "
+                            "optimizer steps")
+
+                def inner(st, xs):
+                    st, m = step(st, batch if xs is None else xs)
+                    return st, m
+
+                state, ms = lax.scan(
+                    inner, state, batch if stacked_batch else None,
+                    length=None if stacked_batch else steps_per_call)
+                return state, jax.tree.map(lambda x: x[-1], ms)
+
         jitted = jax.jit(
-            step,
+            body,
             in_shardings=(state_shardings, batch_sharding),
             out_shardings=(state_shardings, NamedSharding(self.mesh, P())),
             donate_argnums=(0,) if donate else (),
