@@ -119,3 +119,21 @@ class FSDP:
             out_shardings=(state_shardings, NamedSharding(self.mesh, P())),
             donate_argnums=(0,) if donate else (),
         )
+
+    def make_eval_step(self, metric_fn, state_shardings: Any):
+        """``(state, batch) -> metrics`` — the no-grad half for the
+        Evaluator: params stay in their ZeRO-3 shards (GSPMD gathers the
+        transient copies exactly as in training), state untouched.
+        ``metric_fn(params, batch) -> {name: scalar}``."""
+        batch_sharding = NamedSharding(self.mesh, P(self.axis))
+        param_shardings = state_shardings.params
+
+        def step(params, batch):
+            return metric_fn(params, batch)
+
+        jitted = jax.jit(
+            step,
+            in_shardings=(param_shardings, batch_sharding),
+            out_shardings=NamedSharding(self.mesh, P()),
+        )
+        return lambda state, batch: jitted(state.params, batch)

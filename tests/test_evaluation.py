@@ -219,3 +219,41 @@ def test_tp_eval_matches_unsharded():
     for k in want:
         np.testing.assert_allclose(
             float(got[k]), float(want[k]), rtol=1e-5, atol=1e-6)
+
+
+def test_fsdp_eval_matches_unsharded():
+    """FSDP.make_eval_step (ZeRO-3 sharded params) == plain metric on the
+    gathered params."""
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+    from distributed_tensorflow_guide_tpu.parallel.fsdp import FSDP
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    fsdp = FSDP(mesh)
+    model = MNISTCNN()
+
+    def init_fn():
+        return model.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, 28, 28, 1)))["params"]
+
+    params, shardings = fsdp.init_params(init_fn)
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.1))
+    st_shard = fsdp.state_shardings(state, shardings)
+    state = jax.device_put(state, st_shard)
+
+    def metric_fn(p, b):
+        logits = model.apply({"params": p}, b["image"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["label"]).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == b["label"])
+        return {"loss": loss, "accuracy": acc}
+
+    ev_step = fsdp.make_eval_step(metric_fn, st_shard)
+    rng = np.random.RandomState(0)
+    batch = {"image": rng.randn(16, 28, 28, 1).astype(np.float32),
+             "label": rng.randint(0, 10, 16).astype(np.int32)}
+    got = ev_step(state, batch)
+    want = metric_fn(jax.device_get(state.params), batch)
+    for k in want:
+        np.testing.assert_allclose(float(got[k]), float(want[k]),
+                                   rtol=1e-5, atol=1e-6)
