@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Serving throughput: KV-cache autoregressive decode, tokens/sec.
+
+GPT-2 124M by default (--small for the CPU smoke geometry). The whole
+generate call is ONE compiled program (prefill + lax.scan decode loop), so
+the measured number includes everything a serving step pays: per-token
+attention over the cache, sampling, cache updates — but only one host
+dispatch per call.
+
+Reports decode tokens/sec (new tokens x batch / time, prompt ingestion
+excluded from the token count but included in the time — conservative).
+"""
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.common import device_setup, report
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--max-new", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--temperature", type=float, default=1.0)
+    ap.add_argument("--top-k", type=int, default=40)
+    ap.add_argument("--small", action="store_true")
+    ap.add_argument("--fake-devices", type=int, default=0)
+    args = ap.parse_args()
+
+    device_setup(args.fake_devices)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_guide_tpu.models.generation import (
+        make_generate_fn,
+    )
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        gpt2_124m,
+    )
+
+    if args.small:
+        cfg = TransformerConfig(
+            vocab_size=1024, num_layers=2, num_heads=4, d_model=128,
+            d_ff=512, max_len=args.prompt_len + args.max_new,
+            causal=True, dtype=jnp.float32)
+    else:
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            gpt2_124m(), max_len=max(1024, args.prompt_len + args.max_new))
+    model = Transformer(cfg)
+    params = jax.jit(model.init)(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, cfg.max_len), jnp.int32))["params"]
+
+    gen = make_generate_fn(cfg, max_new_tokens=args.max_new,
+                           temperature=args.temperature, top_k=args.top_k)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, cfg.vocab_size,
+                         (args.batch, args.prompt_len)).astype(np.int32)
+
+    out = gen(params, prompt, jax.random.PRNGKey(0))  # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        out = gen(params, prompt, jax.random.PRNGKey(i + 1))
+    np.asarray(out)  # value fetch closes the timed region (common.py note)
+    dt = time.perf_counter() - t0
+
+    report("gpt2_decode_throughput",
+           args.batch * args.max_new * args.iters / dt, "tokens/sec",
+           batch=args.batch, prompt_len=args.prompt_len,
+           max_new=args.max_new)
+
+
+if __name__ == "__main__":
+    main()

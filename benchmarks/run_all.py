@@ -24,6 +24,7 @@ BENCHES = [
     "bench_moe_lm.py",        # EP model family: Switch-MoE LM tokens/sec
     "bench_fsdp_memory.py",   # FSDP: per-device state bytes vs replicated DP
     "bench_sp_comm.py",       # SP layouts: ring vs Ulysses ICI traffic
+    "bench_generate.py",      # serving: KV-cache decode tokens/sec
 ]
 
 # Tiny fake-device configs, small enough for CPU (also used by
@@ -69,6 +70,9 @@ SMOKE = {
         # contract the judged ResNet config trains under (round-5)
         ["--fake-devices", "4", "--global-batch", "16", "--records", "128",
          "--steps", "3", "--image-size", "64", "--augment"],
+    "bench_generate.py":
+        ["--fake-devices", "1", "--small", "--batch", "2",
+         "--prompt-len", "16", "--max-new", "8", "--iters", "2"],
 }
 
 

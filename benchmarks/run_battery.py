@@ -48,6 +48,7 @@ BATTERY: list[tuple[str, list[str], int]] = [
      ["benchmarks/bench_gpt2_pp.py", "--seq-len", "2048",
       "--microbatch-size", "1"], 1800),
     ("bert_tp", ["benchmarks/bench_bert_tp.py"], 1800),
+    ("gpt2_decode", ["benchmarks/bench_generate.py"], 1800),
     ("ring_attention_1024",
      ["benchmarks/bench_ring_attention.py", "--seq-len", "1024"], 1500),
     ("ring_attention_2048",
