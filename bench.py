@@ -189,8 +189,10 @@ def run_bench() -> None:
     # window is the conservative, reproducible instrument.
     from benchmarks.common import time_steps
 
-    n_steps = 120
-    n_trials = 3
+    # BENCH_STEPS/BENCH_TRIALS: smoke/A-B knobs (CPU can't run the judged
+    # 3x120 windows); defaults are the judged methodology.
+    n_steps = int(os.environ.get("BENCH_STEPS", "120"))
+    n_trials = int(os.environ.get("BENCH_TRIALS", "3"))
     trial_tput: list[float] = []
     dt, state = time_steps(step, state, batch, warmup=3, steps=n_steps)
     trial_tput.append(global_batch * n_steps / dt / n_dev)
