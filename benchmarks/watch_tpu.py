@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""TPU transport watcher: probe until the chip answers, then capture.
+
+The axon tunnel flaps (rounds 3-5 each lost capture windows to it, in two
+signatures — relay ports gone, and ports up with the backend hung). This
+watcher turns "the chip was up at 3am for 20 minutes" into recorded
+evidence: it probes on an interval with a hard kill timeout (a hung PJRT
+init cannot be interrupted in-process — always a subprocess), and the
+first time a probe answers it fires ``run_battery.py`` once and exits.
+
+    python benchmarks/watch_tpu.py                # defaults: 7 min, ~12 h
+    python benchmarks/watch_tpu.py --once         # single probe, no battery
+    nohup python benchmarks/watch_tpu.py >> bench_results/watch.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def probe(timeout_s: float) -> bool:
+    try:
+        r = subprocess.run(
+            [sys.executable, str(ROOT / "bench.py"), "--probe"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            timeout=timeout_s, cwd=ROOT)
+        return r.returncode == 0 and "probe-ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=420.0,
+                    help="seconds between probes")
+    ap.add_argument("--probe-timeout", type=float, default=100.0)
+    ap.add_argument("--max-iters", type=int, default=80,
+                    help="give up after this many dead probes")
+    ap.add_argument("--once", action="store_true",
+                    help="probe once, report, exit (no battery)")
+    ap.add_argument("--battery-args", nargs=argparse.REMAINDER, default=[],
+                    help="forwarded to run_battery.py")
+    args = ap.parse_args()
+
+    def log(msg: str) -> None:
+        print(f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} {msg}",
+              flush=True)
+
+    for i in range(1, args.max_iters + 1):
+        if probe(args.probe_timeout):
+            log(f"probe ok on iteration {i}")
+            if args.once:
+                return 0
+            log("running capture battery")
+            r = subprocess.run(
+                [sys.executable, str(ROOT / "benchmarks" / "run_battery.py"),
+                 *args.battery_args], cwd=ROOT)
+            log(f"battery done (rc={r.returncode})")
+            return r.returncode
+        log(f"probe dead (iter {i}/{args.max_iters})")
+        if args.once:
+            return 1
+        time.sleep(args.interval)
+    log("gave up: transport never answered")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
