@@ -4,9 +4,12 @@ blockwise path (SURVEY.md §5's designated hard native part).
 
 Causal forward+backward through shard_map over the ``context`` axis; the
 metric is tokens/sec for the Pallas implementation, with ``vs_baseline`` =
-pallas/xla speedup at the same shapes. Round-3 on-chip reference numbers
-(B=4, H=12, D=64, bf16): seq 1024 — 423k vs 66k tok/s (6.4x); 2048 —
-355k vs 202k (1.76x); 4096 — 229k vs 218k.
+pallas/xla speedup at the same shapes (< 1.0 means XLA wins). Round-5
+driver-verified on-chip numbers (B=4, H=12, D=64, bf16): seq 1024 — Pallas
+87k vs XLA ~554k tok/s (0.157x); 2048 — 0.255x; 4096 — 0.487x. XLA wins at
+every measured length, which is why ``ring_attention`` impl="auto" selects
+it (parallel/sequence.py); the JSON line echoes what auto resolves to so a
+capture can prove the policy matches the measurement.
 
     python benchmarks/bench_ring_attention.py --seq-len 2048
     python benchmarks/bench_ring_attention.py --fake-devices 8 --context 4
@@ -45,9 +48,11 @@ def main() -> None:
     import numpy as np
     from jax.sharding import PartitionSpec as P
 
+    from distributed_tensorflow_guide_tpu.core.compat import shard_map
     from distributed_tensorflow_guide_tpu.core.dist import initialize
     from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
     from distributed_tensorflow_guide_tpu.parallel.sequence import (
+        RING_AUTO_IMPL,
         ring_attention,
     )
 
@@ -74,7 +79,7 @@ def main() -> None:
     )
 
     def bench(impl) -> float:
-        step = jax.jit(jax.value_and_grad(lambda q: jnp.sum(jax.shard_map(
+        step = jax.jit(jax.value_and_grad(lambda q: jnp.sum(shard_map(
             functools.partial(ring_attention, causal=True, impl=impl),
             mesh=mesh,
             in_specs=(P(None, "context"),) * 3,
@@ -94,8 +99,15 @@ def main() -> None:
 
     tok_pallas = bench("pallas")
     tok_xla = bench("xla")
+    # auto's pick is read from the policy's single source of truth
+    # (sequence.RING_AUTO_IMPL) and echoed with both measured rates, so
+    # the capture itself proves whether auto selected the faster path
+    auto_is_faster = (tok_xla >= tok_pallas) == (RING_AUTO_IMPL == "xla")
     report("ring_attention_pallas_throughput", tok_pallas, "tokens/sec",
-           baseline=tok_xla)
+           baseline=tok_xla,
+           xla_tokens_per_sec=round(tok_xla, 1),
+           auto_impl=RING_AUTO_IMPL,
+           auto_selected_measured_winner=bool(auto_is_faster))
 
 
 if __name__ == "__main__":

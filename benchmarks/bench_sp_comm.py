@@ -69,6 +69,7 @@ def main() -> None:
     from jax.sharding import PartitionSpec as P
 
     import distributed_tensorflow_guide_tpu.collectives as cc
+    from distributed_tensorflow_guide_tpu.core.compat import shard_map
     from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
     from distributed_tensorflow_guide_tpu.parallel.sequence import (
         ring_attention,
@@ -91,7 +92,7 @@ def main() -> None:
     def lower(fn):
         """Trace the sharded program; trace_comm records per-device shard
         bytes at each wrapper call site."""
-        sm = jax.shard_map(
+        sm = shard_map(
             fn, mesh=mesh,
             in_specs=(P(None, "context"),) * 3,
             out_specs=P(None, "context"),
@@ -105,7 +106,7 @@ def main() -> None:
         """Trace fwd+bwd: the Pallas ring's hand-written backward issues
         its ppermutes through the wrapper layer, so grad-tracing sees
         them; autodiff-transposed collectives (Ulysses bwd) do not."""
-        sm = jax.shard_map(
+        sm = shard_map(
             fn, mesh=mesh,
             in_specs=(P(None, "context"),) * 3,
             out_specs=P(None, "context"),
@@ -141,6 +142,12 @@ def main() -> None:
     ring_fb_wire = ring_fb.bytes["ppermute[context]"] * n
     uly_fb_wire = 2 * uly_wire
 
+    def ratio(a: int, b: int):
+        """ring/Ulysses wire ratio; None on a degenerate axis (context=1:
+        every count is 0 bytes — there is nobody to talk to, and the old
+        bare division was the battery's round-5 ZeroDivisionError)."""
+        return round(a / b, 2) if b else None
+
     print(json.dumps({
         "metric": "sp_ici_bytes_per_device",
         "value": round(ring_fb_wire / 2**20, 3),
@@ -149,12 +156,12 @@ def main() -> None:
         "fwd": {
             "ring_mb": round(ring_wire / 2**20, 3),
             "ulysses_mb": round(uly_wire / 2**20, 3),
-            "ring_over_ulysses": round(ring_wire / uly_wire, 2),
+            "ring_over_ulysses": ratio(ring_wire, uly_wire),
         },
         "fwd_bwd": {
             "ring_mb": round(ring_fb_wire / 2**20, 3),
             "ulysses_mb": round(uly_fb_wire / 2**20, 3),
-            "ring_over_ulysses": round(ring_fb_wire / uly_fb_wire, 2),
+            "ring_over_ulysses": ratio(ring_fb_wire, uly_fb_wire),
             # q-side rotation: q, dout, dq-partial + 2 lane-thin stats
             "ring_bwd_tensors_per_hop": "3 + 2 thin",
             "ulysses_bwd": "analytic (autodiff transpose of 4 all_to_alls)",

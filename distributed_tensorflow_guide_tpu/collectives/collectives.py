@@ -89,8 +89,9 @@ def record_event(op: str, axis: Any, tree: Any = None) -> None:
     """Record a named NON-collective event into the active ``trace_comm``.
 
     For decisions that change the comm/compute profile without issuing a
-    collective themselves — e.g. ``ring_attention`` impl="auto" silently
-    taking the ~2x-FLOP XLA path for non-lane-aligned shapes. Shows up in
+    collective themselves — e.g. a kernel auto-policy silently taking a
+    differently-shaped path (ops/flash_attention's blockwise fallback
+    registry is the package-wide sibling). Shows up in
     ``CommTrace.calls`` under ``op[axis]`` like any collective, so a test
     (or a user auditing a trace) sees the degradation instead of guessing
     from throughput."""
@@ -98,8 +99,14 @@ def record_event(op: str, axis: Any, tree: Any = None) -> None:
 
 
 def axis_size(axis: str) -> int:
-    """Size of a mesh axis from inside shard_map (NCCL world-size analogue)."""
-    return lax.axis_size(axis)
+    """Size of a mesh axis from inside shard_map (NCCL world-size analogue).
+
+    ``lax.axis_size`` only exists on newer JAX; ``psum(1, axis)`` is the
+    portable spelling and constant-folds to the same Python int at trace
+    time (it is not a collective — no wire traffic, nothing recorded)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
 
 
 def psum(x, axis: str | tuple[str, ...]):
@@ -149,7 +156,7 @@ def ring_shift(x, axis: str, *, shift: int = 1):
     Device i sends to device (i+shift) mod n — the KV-rotation primitive of
     ring attention and the activation hand-off of pipeline parallelism.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return ppermute(x, axis, perm)
 

@@ -1,0 +1,151 @@
+"""JAX version compatibility seam.
+
+The framework targets the JAX 0.9 surface (``jax.shard_map`` with
+``check_vma``, the ``jax_num_cpu_devices`` config) but must also run on the
+0.4.x line installed in some environments, where the same capabilities live
+under ``jax.experimental.shard_map.shard_map(check_rep=...)`` and the CPU
+device count is only settable via ``XLA_FLAGS=--xla_force_host_platform_
+device_count`` before backend init. Every version-sensitive call goes
+through this module so the rest of the codebase is written once, against
+the modern names.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+log = logging.getLogger("dtg.compat")
+
+_HAS_SHARD_MAP = hasattr(jax, "shard_map")        # jax >= 0.6
+_HAS_NUM_CPU_CONFIG = hasattr(jax.config, "jax_num_cpu_devices")  # >= 0.5
+
+if not _HAS_SHARD_MAP:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the modern keyword surface on every JAX.
+
+    ``check_vma`` (varying-manual-axes checking, 0.9) and ``check_rep``
+    (replication checking, 0.4) gate the same machinery — static validation
+    of per-axis replication of shard_map outputs; the framework always
+    passes ``check_vma=False`` where collectives are explicit, which maps
+    to ``check_rep=False`` exactly.
+    """
+    if _HAS_SHARD_MAP:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=check_vma)
+
+
+def num_cpu_devices_config_supported() -> bool:
+    """Whether ``jax.config.update("jax_num_cpu_devices", n)`` exists."""
+    return _HAS_NUM_CPU_CONFIG
+
+
+def set_cpu_device_count(n: int, *, pre_import_env: bool = True) -> None:
+    """Request ``n`` virtual CPU devices, whichever way this JAX supports.
+
+    On ≥0.5 this is the ``jax_num_cpu_devices`` config (appliable any time
+    before backend init). On 0.4.x the only mechanism is the
+    ``--xla_force_host_platform_device_count`` XLA flag, which the CPU
+    client reads from the environment when it is created — so this must run
+    before the first ``jax.devices()``/computation. Callers that can set
+    the environment before ``import jax`` (launchers, conftest) should
+    still do that too (``pre_import_env``); this function is the
+    post-import half.
+    """
+    if _HAS_NUM_CPU_CONFIG:
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    if pre_import_env:
+        import re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={n}"
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags)
+        else:
+            flags = (flags + " " + flag).strip()
+        os.environ["XLA_FLAGS"] = flags
+
+
+def apply_cpu_device_count(n: int) -> None:
+    """Like :func:`set_cpu_device_count`, but with the modern config's
+    failure contract on every JAX: raises ``RuntimeError`` when a live
+    backend has already fixed a DIFFERENT device count (on ≥0.5 the config
+    update itself raises; on 0.4.x the XLA flag would just be silently
+    ignored, so the liveness check reproduces the error).
+    """
+    if _HAS_NUM_CPU_CONFIG:
+        if jax.config.jax_num_cpu_devices != n:
+            jax.config.update("jax_num_cpu_devices", n)
+        return
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        if len(jax.devices()) != n:
+            raise RuntimeError(
+                f"cannot apply a CPU device count of {n}: a backend with "
+                f"{len(jax.devices())} devices is already initialized and "
+                "this JAX has no jax_num_cpu_devices config")
+        return
+    set_cpu_device_count(n)
+
+
+def device_put_global(tree, shardings):
+    """``jax.device_put`` onto shardings that may span NON-addressable
+    devices (multi-process global meshes).
+
+    Newer JAX accepts such shardings directly; the 0.4.x jaxlib refuses
+    ("must represent addressable devices"). The fallback rebuilds each leaf
+    as a global array via ``make_array_from_callback``, which materializes
+    only this process's addressable shards — requiring the leaf to be
+    host-materializable (host value, or an array this process can read),
+    true for the replicated init/state flows that need this. A leaf that
+    already IS a global array with an equivalent sharding passes through
+    untouched (re-placement would be a no-op anyway).
+
+    ``shardings`` is a single sharding (applied to every leaf) or a
+    matching pytree, as with ``jax.device_put``.
+    """
+    import numpy as np
+
+    one_sharding = isinstance(shardings, jax.sharding.Sharding)
+
+    def _one(x, s):
+        try:
+            return jax.device_put(x, s)
+        except ValueError:
+            if (isinstance(x, jax.Array)
+                    and x.sharding.is_equivalent_to(s, x.ndim)):
+                return x
+            arr = np.asarray(x)
+            return jax.make_array_from_callback(
+                arr.shape, s, lambda idx: arr[idx])
+
+    if one_sharding:
+        return jax.tree.map(lambda x: _one(x, shardings), tree)
+    return jax.tree.map(_one, tree, shardings)
+
+
+def enable_cpu_cross_process_collectives() -> None:
+    """Gloo-backed cross-process CPU collectives.
+
+    Newer JAX wires these up by itself; the 0.4.x line ships them behind
+    ``jax_cpu_collectives_implementation`` (default "none" — a
+    multi-process psum then fails with "Multiprocess computations aren't
+    implemented on the CPU backend"). Must run before the CPU client is
+    created. No-op where the config has been removed.
+    """
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
+    # the env var reaches CHILD processes that build their own client
+    os.environ.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")

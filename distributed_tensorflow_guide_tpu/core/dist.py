@@ -101,6 +101,8 @@ def ensure_platform_from_env(*, strict: bool = True) -> None:
                     f"JAX_NUM_CPU_DEVICES={ndev!r} is not an integer"
                 ) from e
             log.debug("platform env not applied (malformed): %s", e)
+    from distributed_tensorflow_guide_tpu.core import compat
+
     try:
         if plat and jax.config.jax_platforms != plat:
             log.info(
@@ -108,8 +110,13 @@ def ensure_platform_from_env(*, strict: bool = True) -> None:
                 plat, jax.config.jax_platforms,
             )
             jax.config.update("jax_platforms", plat)
-        if ndev_int is not None and jax.config.jax_num_cpu_devices != ndev_int:
-            jax.config.update("jax_num_cpu_devices", ndev_int)
+        if ndev_int is not None:
+            # compat owns the version split (config on >= 0.5, XLA flag on
+            # 0.4.x) AND the failure contract: RuntimeError when a live
+            # backend already fixed a different count — which the
+            # strict/best-effort handling below turns into the actionable
+            # message or a debug log.
+            compat.apply_cpu_device_count(ndev_int)
     except RuntimeError as e:
         if strict:
             raise RuntimeError(
@@ -166,6 +173,14 @@ def initialize(config: DistConfig | None = None) -> None:
     # explicit config keeps its no-env-leakage guarantee (comment above).
     if not explicit:
         ensure_platform_from_env(strict=True)
+    from distributed_tensorflow_guide_tpu.core import compat
+
+    if (os.environ.get("JAX_PLATFORMS", "") or "").startswith("cpu") or (
+            jax.config.jax_platforms or "").startswith("cpu"):
+        # CPU multi-process needs Gloo collectives, an opt-in flag on the
+        # 0.4.x JAX line (the default elsewhere) — without it every
+        # cross-process psum dies at dispatch
+        compat.enable_cpu_cross_process_collectives()
     kwargs = {}
     if coord is not None:
         kwargs["coordinator_address"] = coord

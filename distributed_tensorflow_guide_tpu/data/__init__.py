@@ -15,6 +15,13 @@ from distributed_tensorflow_guide_tpu.data.importers import (  # noqa: F401
     read_idx,
     write_idx,
 )
+from distributed_tensorflow_guide_tpu.data.prefetch import (  # noqa: F401
+    DevicePrefetchIterator,
+    PrefetchStats,
+    pack_batches,
+    pack_stream,
+    prefetch_to_device,
+)
 from distributed_tensorflow_guide_tpu.data.synthetic import (  # noqa: F401
     SyntheticClassification,
     SyntheticCTR,

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.core.compat import shard_map
 from distributed_tensorflow_guide_tpu.core.mesh import axis_sizes
 from distributed_tensorflow_guide_tpu.models.transformer import (
     MultiHeadAttention,
@@ -228,7 +229,7 @@ class SwitchLM:
             params = optax.apply_updates(params, updates)
             return opt_state, params, {"loss": loss, **mets}
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             sm_step,
             mesh=self.mesh,
             in_specs=(opt_specs, specs, P(self.moe_cfg.token_axes)),

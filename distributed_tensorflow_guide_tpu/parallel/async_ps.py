@@ -45,6 +45,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.core.compat import shard_map
 from distributed_tensorflow_guide_tpu.core.mesh import axis_sizes
 from distributed_tensorflow_guide_tpu.parallel.grad_accum import (
     accumulate_grads,
@@ -119,7 +120,7 @@ class LocalSGD(_Strategy):
             mets = {"loss": cc.pmean(losses.mean(), self.axis)}
             return state, mets
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             sm_step,
             mesh=self.mesh,
             in_specs=(P(), P(None, self.axis)),
@@ -181,7 +182,7 @@ class GossipSGD(_Strategy):
             new_state = jax.tree.map(lambda x: x[None], local)
             return new_state, {"loss": cc.pmean(loss, self.axis)}
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             sm_step,
             mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis)),
@@ -221,7 +222,7 @@ class AccumulatedAdaptive(_Strategy):
             state = state.apply_gradients(grads=g)
             return state, {"loss": cc.pmean(losses.mean(), self.axis)}
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             sm_step,
             mesh=self.mesh,
             in_specs=(P(), P(None, self.axis)),

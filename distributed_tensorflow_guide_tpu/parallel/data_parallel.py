@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.core.compat import shard_map
 from distributed_tensorflow_guide_tpu.core.mesh import axis_sizes
 from distributed_tensorflow_guide_tpu.parallel.grad_accum import (
     accumulate_grads,
@@ -66,15 +67,55 @@ class DataParallel:
             )
         return jax.device_put(batch, sharding)
 
+    def batch_sharding(self, stacked: bool = False) -> NamedSharding:
+        """The placement of a step's batch argument: leading axis sharded
+        over ``data`` — or, for a ``stacked_batch`` multi-step super-batch,
+        the SECOND axis (the leading one is the inner-step index)."""
+        spec = P(None, self.axis) if stacked else P(self.axis)
+        return NamedSharding(self.mesh, spec)
+
+    def shard_packed_batch(self, packed: Any) -> Any:
+        """Place one ``steps_per_call`` super-batch (leading axis = inner
+        step, from data/prefetch.py ``pack_batches``) onto the mesh."""
+        sharding = self.batch_sharding(stacked=True)
+        if jax.process_count() > 1:
+            return jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(sharding, x),
+                packed,
+            )
+        return jax.device_put(packed, sharding)
+
+    def prefetch(self, source, *, depth: int = 2, steps_per_call: int = 1,
+                 drop_remainder: bool = True):
+        """Wrap a host-batch iterable in the device-prefetch overlap stage
+        (data/prefetch.py), placed with this strategy's sharding. With
+        ``steps_per_call > 1`` each yielded item is a packed super-batch
+        ready for the multi-step compiled step."""
+        from distributed_tensorflow_guide_tpu.data.prefetch import (
+            prefetch_to_device,
+        )
+
+        put = (self.shard_packed_batch if steps_per_call > 1
+               else self.shard_batch)
+        return prefetch_to_device(source, depth=depth, put_fn=put,
+                                  steps_per_call=steps_per_call,
+                                  drop_remainder=drop_remainder)
+
     def replicate(self, state: Any) -> Any:
         """Replicate a state pytree across every device (params live
-        everywhere — the anti-PS: no parameter server holds them)."""
-        sharding = NamedSharding(self.mesh, P())
-        return jax.device_put(state, sharding)
+        everywhere — the anti-PS: no parameter server holds them).
+        Multi-process meshes include non-addressable devices, which
+        compat.device_put_global handles on every JAX line."""
+        from distributed_tensorflow_guide_tpu.core.compat import (
+            device_put_global,
+        )
+
+        return device_put_global(state, NamedSharding(self.mesh, P()))
 
     # ---- compiled steps ----------------------------------------------------
     def _compile_step(self, sm_step, donate: bool, steps_per_call: int = 1,
-                      stacked_batch: bool = False):
+                      stacked_batch: bool = False,
+                      per_step_metrics: bool = False):
         """shard_map + jit a per-device ``(state, batch) -> (state, metrics)``
         body: state replicated, batch sharded on its leading axis,
         explicit collectives (hence check_vma=False).
@@ -89,7 +130,10 @@ class DataParallel:
         batch carries a leading ``steps_per_call`` axis (one microbatch per
         inner step — the real-training mode); otherwise the same batch is
         re-used every inner step (synthetic benchmarking mode). Metrics
-        returned are the LAST inner step's.
+        returned are the LAST inner step's, unless ``per_step_metrics``:
+        then every metric keeps the scan's leading ``steps_per_call`` axis,
+        one slice per inner step — what lets TrainLoop keep hooks observing
+        every optimizer step across a fused dispatch.
         """
         if steps_per_call < 1:
             raise ValueError(
@@ -102,7 +146,7 @@ class DataParallel:
                     "batch's leading axis is consumed one slice per inner "
                     "step)"
                 )
-            sharded = jax.shard_map(
+            sharded = shard_map(
                 sm_step,
                 mesh=self.mesh,
                 in_specs=(P(), P(self.axis)),
@@ -110,6 +154,10 @@ class DataParallel:
                 check_vma=False,
             )
             return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+        def pick(ms):
+            return ms if per_step_metrics else jax.tree.map(
+                lambda x: x[-1], ms)
 
         if stacked_batch:
             def multi(state, batch):
@@ -122,7 +170,7 @@ class DataParallel:
                     )
 
                 state, ms = lax.scan(sm_step, state, batch)
-                return state, jax.tree.map(lambda x: x[-1], ms)
+                return state, pick(ms)
         else:
             def multi(state, batch):
                 def body(st, _):
@@ -132,11 +180,11 @@ class DataParallel:
                 state, ms = lax.scan(
                     body, state, None, length=steps_per_call
                 )
-                return state, jax.tree.map(lambda x: x[-1], ms)
+                return state, pick(ms)
 
         batch_spec = (P(None, self.axis) if stacked_batch
                       else P(self.axis))
-        multi_sharded = jax.shard_map(
+        multi_sharded = shard_map(
             multi,
             mesh=self.mesh,
             in_specs=(P(), batch_spec),
@@ -150,7 +198,8 @@ class DataParallel:
 
     def make_train_step(self, loss_fn: LossFn, *, donate: bool = True,
                         accum_steps: int = 1, steps_per_call: int = 1,
-                        stacked_batch: bool = False):
+                        stacked_batch: bool = False,
+                        per_step_metrics: bool = False):
         """Compile ``(state, batch) -> (state, metrics)``.
 
         ``state`` is a flax TrainState (replicated); ``batch`` a pytree
@@ -197,11 +246,12 @@ class DataParallel:
             return state, self._pmean_metrics({"loss": loss, **mets})
 
         return self._compile_step(sm_step, donate, steps_per_call,
-                                  stacked_batch)
+                                  stacked_batch, per_step_metrics)
 
     def make_train_step_with_stats(self, loss_fn, *, donate: bool = True,
                                    steps_per_call: int = 1,
-                                   stacked_batch: bool = False):
+                                   stacked_batch: bool = False,
+                                   per_step_metrics: bool = False):
         """Like :meth:`make_train_step` for models with non-trainable state
         (BatchNorm running stats).
 
@@ -224,7 +274,7 @@ class DataParallel:
             return state, self._pmean_metrics({"loss": loss, **mets})
 
         return self._compile_step(sm_step, donate, steps_per_call,
-                                  stacked_batch)
+                                  stacked_batch, per_step_metrics)
 
     def make_eval_step(self, metric_fn: Callable[[Any, Any], dict]):
         """Compile ``(state, batch) -> metrics`` with pmean-ed metrics."""
@@ -233,7 +283,7 @@ class DataParallel:
             mets = metric_fn(state.params, batch)
             return {k: cc.pmean(v, self.axis) for k, v in mets.items()}
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             sm_eval,
             mesh=self.mesh,
             in_specs=(P(), P(self.axis)),
@@ -252,7 +302,7 @@ class DataParallel:
             mets = metric_fn(state.params, state.model_state, batch)
             return {k: cc.pmean(v, self.axis) for k, v in mets.items()}
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             sm_eval,
             mesh=self.mesh,
             in_specs=(P(), P(self.axis)),

@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.core.compat import shard_map
 from distributed_tensorflow_guide_tpu.core.mesh import axis_sizes
 
 
@@ -115,7 +116,7 @@ def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig):
     Per-device shapes: x (T_local, d); w_in (E_local, d, ff).
     Returns (y (T_local, d), aux dict with load_balance/z losses).
     """
-    n_dev = lax.axis_size(cfg.axis)
+    n_dev = cc.axis_size(cfg.axis)
     e_global = cfg.num_experts
     e_local = params["w_in"].shape[0]
     if e_local * n_dev != e_global:
@@ -201,7 +202,7 @@ class ExpertParallel:
             )
             def run(params, x):
                 fn = functools.partial(moe_ffn, cfg=cfg)
-                return jax.shard_map(
+                return shard_map(
                     fn, mesh=self.mesh,
                     in_specs=(self.param_spec, self.token_spec),
                     out_specs=(self.token_spec, P()),
@@ -245,7 +246,7 @@ class ExpertParallel:
                                   params, grads)
             return params, {"loss": loss, **aux}
 
-        sm = jax.shard_map(
+        sm = shard_map(
             step, mesh=self.mesh,
             in_specs=(self.param_spec, self.token_spec, self.token_spec),
             out_specs=(self.param_spec, P()),

@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.core.compat import shard_map
 from distributed_tensorflow_guide_tpu.core.mesh import axis_sizes
 from distributed_tensorflow_guide_tpu.utils.spec_utils import expand_prefix
 from distributed_tensorflow_guide_tpu.models.transformer import (
@@ -1397,7 +1398,7 @@ class PipelinedLM:
 
             tokens_spec = P(None, "data") if stacked_batch else P("data")
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             body,
             mesh=self.mesh,
             in_specs=(opt_specs, self.param_specs(), tokens_spec),
@@ -1426,7 +1427,7 @@ class PipelinedLM:
                 loss = cc.pmean(loss, "data")
             return {"loss": loss, "perplexity": jnp.exp(loss)}
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             sm_eval,
             mesh=self.mesh,
             in_specs=(self.param_specs(), P("data")),

@@ -32,6 +32,13 @@ import distributed_tensorflow_guide_tpu.collectives as cc
 from distributed_tensorflow_guide_tpu.ops import attention as A
 from distributed_tensorflow_guide_tpu.ops import flash_attention as F
 
+# What ring_attention's impl="auto" resolves to — the ONE place the policy
+# lives, so instruments (benchmarks/bench_ring_attention.py) report the
+# actual pick instead of restating it. "xla" per the round-5 on-chip
+# battery (Pallas at 0.157–0.487x of XLA at seq 1k–4k); flip here when a
+# future capture inverts it.
+RING_AUTO_IMPL = "xla"
+
 
 def ring_attention(q, k, v, *, axis: str = "context", causal: bool = False,
                    impl: str = "auto"):
@@ -40,47 +47,37 @@ def ring_attention(q, k, v, *, axis: str = "context", causal: bool = False,
     Per-device shapes (B, S_local, H, D); the global sequence is the
     concatenation of shards in axis order. Must run inside shard_map.
 
-    ``impl``: "pallas" fuses each rotation's blockwise update into the
-    flash carry kernel (ops/flash_attention.py flash_carry_step) with
-    hand-written ring backward, and SKIPS fully-dead causal rotations
-    (``lax.cond`` executes one branch) — the survey's designated hard
-    native part. "xla" is the pure-XLA blockwise path (the oracle);
-    "auto" picks pallas whenever the shapes fit the kernel.
+    ``impl``: "xla" is the pure-XLA blockwise path — the measured winner
+    on-chip at EVERY tested length (round-5 battery: the Pallas carry path
+    sustained only 0.157/0.255/0.487x of XLA at seq 1k/2k/4k), so "auto"
+    now selects it unconditionally; the round-3 6.4x-the-other-way numbers
+    predate the round-4 rewrites of both paths and are retired in
+    BASELINE.md. "pallas" OPTS IN to the fused carry-kernel path
+    (ops/flash_attention.py flash_carry_step, hand-written ring backward,
+    ``lax.cond`` dead-rotation skip) — the survey's designated hard native
+    part, kept first-class for the planned on-chip bisect and for any part
+    where a future capture shows it winning; it needs S_local % 128 == 0
+    and refuses otherwise rather than silently taking the other path.
     """
     if impl not in ("auto", "pallas", "xla"):
         raise ValueError(f"unknown ring impl {impl!r}")
+    if impl == "auto":
+        impl = RING_AUTO_IMPL
     s_local, d = q.shape[1], q.shape[-1]
-    fits = F.supported(s_local, d)
-    if impl == "pallas" and not fits:
+    if impl == "pallas" and not F.supported(s_local, d):
         # The kernel grid covers s_local // 128 blocks; a ragged tail would
         # be silently left as uninitialized carry memory. Refuse loudly.
         raise ValueError(
             f"impl='pallas' needs per-device seq length divisible by 128 "
             f"(got S_local={s_local}); use impl='xla' or pad the sequence"
         )
-    use_pallas = impl == "pallas" or (impl == "auto" and fits)
-    if impl == "auto" and not fits:
-        # The silent ~6x throughput cliff (round-4 verdict weak 5) made
-        # observable: the XLA path computes-then-masks (~2x FLOPs at large
-        # rings) and skips the fused kernel. Stamp the active trace_comm
-        # and land in the package-wide fallback registry
-        # (ops.flash_attention.fallback_stats) — counted per trace, logged
-        # once per shape.
-        cc.record_event("ring_auto_xla_fallback", axis, q)
-        F._note_fallback(
-            s_local, d, F.LANE, F.LANE, origin="ring_attention.auto",
-            msg=f"ring_attention impl='auto': S_local={s_local} not "
-                "divisible by 128 — falling back to the ~2x-FLOP XLA "
-                "path. Pad the per-device sequence to a multiple of 128 "
-                "to use the Pallas kernel.",
-        )
-    if use_pallas:
+    if impl == "pallas":
         return _ring_flash_public(q, k, v, axis=axis, causal=causal)
     return _ring_xla(q, k, v, axis=axis, causal=causal)
 
 
 def _ring_xla(q, k, v, *, axis: str, causal: bool):
-    n = lax.axis_size(axis)
+    n = cc.axis_size(axis)
     my = lax.axis_index(axis)
     s_local = q.shape[1]
     scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -137,8 +134,12 @@ def _pad_lane(x, d, dp):
 
 def _ring_steps_fwd(q, k, v, axis, causal, scale):
     """Ring forward in kernel layout (B, H, S_loc, D) -> (out, lse)."""
-    n = lax.axis_size(axis)
-    my = lax.axis_index(axis)
+    n = cc.axis_size(axis)
+    # the rotation-source index matters only for causal masking; tracing
+    # axis_index into the non-causal program would put a live-but-unused
+    # PartitionId in the scan carry, which jax 0.4.x's SPMD partitioner
+    # refuses to lower
+    my = lax.axis_index(axis) if causal else jnp.int32(0)
     b, h, s, d = q.shape
     dp = -(-d // F.LANE) * F.LANE
     fwd = [(i, (i + 1) % n) for i in range(n)]
@@ -206,8 +207,9 @@ def _ring_flash_bwd_rule(axis, causal, scale, res, g):
     Reuses the flash backward kernels per rotation; lse re-broadcasts to
     the lane width locally (broadcast is free, rotating it is not)."""
     q, k, v, out, lse = res
-    n = lax.axis_size(axis)
-    my = lax.axis_index(axis)
+    n = cc.axis_size(axis)
+    # causal-only, as in _ring_steps_fwd (PartitionId lowering note there)
+    my = lax.axis_index(axis) if causal else jnp.int32(0)
     fwd = [(i, (i + 1) % n) for i in range(n)]
     f32 = jnp.float32
     d = q.shape[-1]
@@ -294,7 +296,7 @@ def ulysses_attention(q, k, v, *, axis: str = "context",
     """
     if impl not in ("auto", "dense", "flash"):
         raise ValueError(f"unknown ulysses impl {impl!r}")
-    n = lax.axis_size(axis)
+    n = cc.axis_size(axis)
     h = q.shape[2]
     if h % n:
         raise ValueError(

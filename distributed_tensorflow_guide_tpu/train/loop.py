@@ -10,6 +10,21 @@ Here the loop drives a *compiled SPMD step function* instead of a session:
 itself stays off the hot path — it only touches host-side Python between
 dispatches, and fetches metric values asynchronously (they are jax.Arrays;
 conversion blocks only when a hook actually reads them).
+
+``steps_per_call > 1`` is the hot-path overlap mode — the TF
+``steps_per_run`` knob threaded through the whole stack: ``step_fn`` is a
+multi-step compiled program (``parallel/data_parallel.py _compile_step``
+with ``stacked_batch=True, per_step_metrics=True``), each dispatch consumes
+one stacked super-batch of ``k`` host batches (data/prefetch.py packs and
+prefetches them), and the loop fans the scan's per-step metrics back out so
+hooks still observe EVERY optimizer step — logging cadence, step counters
+and JSONL records are unchanged from the single-step loop. What coarsens is
+only stop granularity: a stop requested by a hook takes effect at the next
+dispatch boundary, so a run may overshoot the requesting step by up to
+``k - 1`` steps (sized so the common StopAtStepHook(n) with ``k | n``
+overshoots by zero). Dispatch counts and the host time between dispatches
+are accounted in ``dispatch_stats`` (utils/profiling.py) so the overlap
+the mode buys is measurable.
 """
 
 from __future__ import annotations
@@ -30,6 +45,17 @@ class TrainLoop:
     Unlike MonitoredTrainingSession there is no chief/non-chief split in the
     device program — every process executes the same compiled step; hooks
     internally no-op on non-chief processes where appropriate.
+
+    With ``steps_per_call=k > 1``, ``data`` must yield one PACKED item per
+    dispatch (leading axis = inner step, e.g. from
+    ``DataParallel.prefetch(..., steps_per_call=k)`` or
+    ``data/prefetch.py pack_stream``) and ``step_fn`` must be compiled with
+    ``per_step_metrics=True`` so each metric carries the leading ``k`` axis
+    the loop fans back out to hooks. A final short pack (fewer than ``k``
+    stacked batches) is handed to ``tail_step_fn`` — a SINGLE-step compiled
+    sibling of ``step_fn`` — one dispatch per straggler; without one the
+    tail is dropped with a warning (pass ``drop_remainder=True`` upstream
+    to make that explicit).
     """
 
     def __init__(
@@ -39,14 +65,27 @@ class TrainLoop:
         data: Iterable,
         hooks: Sequence[Hook] = (),
         start_step: int = 0,
+        steps_per_call: int = 1,
+        tail_step_fn: StepFn | None = None,
     ):
+        if steps_per_call < 1:
+            raise ValueError(
+                f"steps_per_call must be >= 1, got {steps_per_call}")
         self.step_fn = step_fn
         self.state = state
         self.data = data
         self.hooks = list(hooks)
         self.step = start_step
+        self.steps_per_call = steps_per_call
+        self.tail_step_fn = tail_step_fn
         self._stop = False
         self.stop_reason: str | None = None
+        self._last_return: float | None = None
+        from distributed_tensorflow_guide_tpu.utils.profiling import (
+            DispatchStats,
+        )
+
+        self.dispatch_stats = DispatchStats()
 
     def request_stop(self, reason: str = "hook") -> None:
         """Hook-callable stop signal (``sess.should_stop()`` equivalent).
@@ -65,6 +104,70 @@ class TrainLoop:
     def should_stop(self) -> bool:
         return self._stop
 
+    # ---- internals ---------------------------------------------------------
+
+    def _dispatch(self, step_fn, batch):
+        """One compiled dispatch with host-gap/dispatch accounting."""
+        import time
+
+        t0 = time.perf_counter()
+        if self._last_return is not None:
+            self.dispatch_stats.host_gap_s += t0 - self._last_return
+        self.state, metrics = step_fn(self.state, batch)
+        self._last_return = time.perf_counter()
+        self.dispatch_stats.dispatch_s += self._last_return - t0
+        self.dispatch_stats.dispatches += 1
+        return metrics
+
+    def _after_step(self, metrics) -> None:
+        for h in self.hooks:
+            h.after_step(self.step, metrics)
+        self.step += 1
+        self.dispatch_stats.steps += 1
+
+    def _pack_len(self, batch) -> int:
+        """Leading-axis length of a packed super-batch (= inner steps)."""
+        import jax
+
+        return int(jax.tree.leaves(batch)[0].shape[0])
+
+    def _run_packed(self, batch) -> None:
+        """Dispatch one packed item and fan per-step metrics to hooks."""
+        import jax
+
+        k = self._pack_len(batch)
+        if k < self.steps_per_call:
+            # short tail pack: one single-step dispatch per straggler
+            if self.tail_step_fn is None:
+                log.warning(
+                    "dropping a tail pack of %d < steps_per_call=%d "
+                    "batches (no tail_step_fn); pass drop_remainder=True "
+                    "upstream to silence, or a tail_step_fn to run them",
+                    k, self.steps_per_call)
+                return
+            for j in range(k):
+                if self._stop:
+                    return
+                single = jax.tree.map(lambda x, j=j: x[j], batch)
+                self._after_step(self._dispatch(self.tail_step_fn, single))
+            return
+        metrics = self._dispatch(self.step_fn, batch)
+        if not jax.tree.leaves(metrics):  # metric-less step: nothing to slice
+            for _ in range(k):
+                self._after_step(metrics)
+            return
+        lead = {getattr(m, "shape", (None,))[0] if getattr(m, "ndim", 1)
+                else None for m in jax.tree.leaves(metrics)}
+        if lead != {k}:
+            raise ValueError(
+                f"steps_per_call={self.steps_per_call} needs per-step "
+                f"metrics (leading axis {k}); got leading sizes {lead} — "
+                "compile the step with per_step_metrics=True")
+        # every inner step happened on device; hooks observe each in order
+        # (stop requests coarsen to the dispatch boundary, documented above)
+        for j in range(k):
+            self._after_step(jax.tree.map(lambda x, j=j: x[j], metrics))
+
     def run(self) -> Any:
         """Run to completion; returns the final state.
 
@@ -80,6 +183,7 @@ class TrainLoop:
         outlive a crashed loop, while keeping state-finalizing work in
         ``end`` where crashes rightly skip it.
         """
+        self._last_return = None
         try:
             # begin() inside the try: if a later hook's begin raises, the
             # finally still runs cleanup() for already-begun hooks (e.g.
@@ -92,10 +196,10 @@ class TrainLoop:
                     batch = next(it)
                 except StopIteration:
                     break
-                self.state, metrics = self.step_fn(self.state, batch)
-                for h in self.hooks:
-                    h.after_step(self.step, metrics)
-                self.step += 1
+                if self.steps_per_call > 1:
+                    self._run_packed(batch)
+                else:
+                    self._after_step(self._dispatch(self.step_fn, batch))
             for h in self.hooks:
                 h.end(self.step)
         finally:

@@ -42,8 +42,22 @@ def activation_mesh(mesh):
 def constrain(x, names):
     """``nn.with_logical_constraint`` that binds when a strategy has
     provided a mesh via :func:`activation_mesh`, and stays advisory
-    otherwise."""
+    otherwise.
+
+    With a mesh set, the wsc is issued DIRECTLY (flax's own
+    ``_with_sharding_constraint`` declares itself "no-op on cpu" in the
+    flax 0.10 line, which would silently un-bind every constraint on the
+    fake-CPU test meshes — the regression the bindingness test pins).
+    Logical→mesh translation still uses the ambient
+    ``nn.logical_axis_rules`` via flax's resolver, unmatched names
+    defaulting to unsharded, so rule semantics are identical."""
     mesh = _ACT_MESH.get()
     if mesh is not None:
-        return nn.with_logical_constraint(x, names, mesh=mesh)
+        import jax
+
+        spec = nn.logical_to_mesh_axes(tuple(names))
+        if spec is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(mesh, spec))
     return nn.with_logical_constraint(x, names)

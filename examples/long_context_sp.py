@@ -43,6 +43,7 @@ def main() -> None:
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from distributed_tensorflow_guide_tpu.core.compat import shard_map
     from distributed_tensorflow_guide_tpu.core.dist import initialize
     from distributed_tensorflow_guide_tpu.core.mesh import (
         MeshSpec,
@@ -75,7 +76,7 @@ def main() -> None:
     seq_sharding = NamedSharding(mesh, P(None, "context"))
 
     def run(name, fn):
-        sharded = jax.jit(jax.shard_map(
+        sharded = jax.jit(shard_map(
             lambda q, k, v: fn(q, k, v, causal=args.causal),
             mesh=mesh,
             in_specs=(P(None, "context"), P(None, "context"),

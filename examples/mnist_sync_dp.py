@@ -50,7 +50,11 @@ def main() -> None:
         # Both the env var (before import) and this update are required: the
         # axon TPU plugin re-asserts its platform during `import jax`.
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.fake_devices)
+        from distributed_tensorflow_guide_tpu.core.compat import (
+            set_cpu_device_count,
+        )
+
+        set_cpu_device_count(args.fake_devices)
 
     import jax.numpy as jnp
     import optax

@@ -41,6 +41,12 @@ def main() -> None:
                     help="held-out evaluation every N steps (always once at "
                          "the end); 0 = end-of-run only")
     ap.add_argument("--eval-batches", type=int, default=4)
+    ap.add_argument("--steps-per-call", type=int, default=1,
+                    help="optimizer steps fused into one compiled dispatch "
+                         "(lax.scan); hooks still see every step's metrics")
+    ap.add_argument("--prefetch-depth", type=int, default=2,
+                    help="device-prefetch buffers: batch N+1 transfers to "
+                         "the mesh while step N computes (data/prefetch.py)")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -50,7 +56,11 @@ def main() -> None:
 
     if args.fake_devices:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.fake_devices)
+        from distributed_tensorflow_guide_tpu.core.compat import (
+            set_cpu_device_count,
+        )
+
+        set_cpu_device_count(args.fake_devices)
 
     import jax.numpy as jnp
     import optax
@@ -103,15 +113,19 @@ def main() -> None:
         model_state={"batch_stats": variables["batch_stats"]},
     ))
 
-    step = dp.make_train_step_with_stats(make_loss_fn(model))
+    k = args.steps_per_call
+    step = dp.make_train_step_with_stats(
+        make_loss_fn(model), steps_per_call=k,
+        stacked_batch=k > 1, per_step_metrics=k > 1)
 
+    # The input overlap stage (data/prefetch.py): host batches are packed k
+    # per dispatch and device_put onto the mesh ahead of the consumer, so
+    # the transfer of pack N+1 rides under the compute of pack N.
     shape = (args.image_size, args.image_size, 3)
-    data = (
-        dp.shard_batch(b)
-        for b in SyntheticClassification(
-            args.global_batch, image_shape=shape,
-            num_classes=args.num_classes)
-    )
+    data = dp.prefetch(
+        SyntheticClassification(args.global_batch, image_shape=shape,
+                                num_classes=args.num_classes),
+        depth=args.prefetch_depth, steps_per_call=k)
     eval_hook = None
     hooks = [StopAtStepHook(args.steps)]
     if args.eval_batches > 0:
@@ -136,14 +150,18 @@ def main() -> None:
                             n_chips=n_dev),
         ]
 
-    loop = TrainLoop(step, state, data, hooks=hooks)
+    tail_step = (dp.make_train_step_with_stats(make_loss_fn(model))
+                 if k > 1 else None)
+    loop = TrainLoop(step, state, data, hooks=hooks, steps_per_call=k,
+                     tail_step_fn=tail_step)
     loop.run()
     tail = ""
     if eval_hook is not None and eval_hook.latest:
         tail = (f"; held-out accuracy {eval_hook.latest['accuracy']:.4f} "
                 f"(loss {eval_hook.latest['loss']:.4f})")
     print(f"done: {loop.step} steps ({args.model}, {args.image_size}px) on "
-          f"{n_dev} device(s){tail}")
+          f"{n_dev} device(s); dispatches: {loop.dispatch_stats.as_dict()}"
+          f"; prefetch: {data.stats.as_dict()}{tail}")
 
 
 if __name__ == "__main__":

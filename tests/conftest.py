@@ -21,10 +21,15 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+from distributed_tensorflow_guide_tpu.core import compat  # noqa: E402
+
 # The axon PJRT plugin re-asserts its platform during `import jax`, so the
-# config must be pinned post-import as well.
+# config must be pinned post-import as well. The device count goes through
+# the compat seam: JAX 0.9 has the jax_num_cpu_devices config, 0.4.x only
+# honors the XLA flag exported above (set before first import — which is
+# why this file must be imported before anything touches a backend).
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+compat.set_cpu_device_count(8)
 
 
 @pytest.fixture(scope="session")

@@ -7,7 +7,7 @@ from jax.sharding import PartitionSpec as P
 
 import distributed_tensorflow_guide_tpu.collectives as cc
 
-shard_map = jax.shard_map
+from distributed_tensorflow_guide_tpu.core.compat import shard_map  # noqa: E402
 
 
 def test_psum_matches_sum(mesh8):

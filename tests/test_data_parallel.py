@@ -175,7 +175,7 @@ def test_steps_per_call_matches_single_steps(batches):
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *bs)
     # leading axis = inner step, second axis sharded over data
     state_b, m_b = multi(state_b, jax.device_put(
-        stacked, jax.NamedSharding(dp.mesh, jax.P(None, "data"))
+        stacked, jax.NamedSharding(dp.mesh, jax.sharding.PartitionSpec(None, "data"))
     ))
 
     np.testing.assert_allclose(np.asarray(m_a["loss"]),

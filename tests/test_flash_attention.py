@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from distributed_tensorflow_guide_tpu.core.compat import shard_map
 from distributed_tensorflow_guide_tpu.ops.attention import dense_attention
 from distributed_tensorflow_guide_tpu.ops.flash_attention import (
     flash_attention,
@@ -84,7 +85,7 @@ def test_flash_under_data_parallel_shard_map():
     n = mesh.devices.shape[0]
     q, k, v = _qkv(b=2 * n, s=128)
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v: flash_attention(q, k, v, causal=True),
             mesh=mesh,
             in_specs=(P("data"),) * 3,
@@ -236,7 +237,7 @@ def test_in_auto_mesh_probe_pinned():
             seen_sm.append(_in_auto_mesh())
             return x
 
-        jax.jit(jax.shard_map(
+        jax.jit(shard_map(
             body, mesh=mesh, in_specs=jax.sharding.PartitionSpec("data"),
             out_specs=jax.sharding.PartitionSpec("data"), check_vma=False,
         )).lower(jnp.zeros(len(jax.devices())))

@@ -15,6 +15,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.core.compat import shard_map
 from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
 from distributed_tensorflow_guide_tpu.parallel.sequence import (
     ring_attention,
@@ -32,7 +33,7 @@ def ctx_mesh():
 def _lower(mesh, fn):
     # global (B, S, H, D); shard_map hands each device (B, S/4, H, D)
     x = jnp.zeros((B, S, H, D), jnp.float32)
-    sm = jax.shard_map(
+    sm = shard_map(
         fn, mesh=mesh,
         in_specs=(P(None, "context"),) * 3,
         out_specs=P(None, "context"),
@@ -77,7 +78,7 @@ def test_ring_pallas_fwd_bwd_comm_sites(ctx_mesh):
     tensors or the full lane-broadcast lse would blow this sum up (the
     pad and broadcast are applied locally per visit instead)."""
     x = jnp.zeros((B, S, H, D), jnp.float32)
-    sm = jax.shard_map(
+    sm = shard_map(
         functools.partial(ring_attention, causal=True, impl="pallas"),
         mesh=ctx_mesh,
         in_specs=(P(None, "context"),) * 3,
@@ -97,47 +98,37 @@ def test_ring_pallas_fwd_bwd_comm_sites(ctx_mesh):
         rec.bytes["ppermute[context]"], t, thin)
 
 
-def test_ring_auto_fallback_is_observable(ctx_mesh, caplog):
-    """impl='auto' on a non-lane-aligned shard (S_local % 128 != 0) takes
-    the XLA path — round-4 verdict weak 5 flagged this as a SILENT ~6x
-    throughput cliff. It must now (a) stamp the active trace_comm with a
-    ring_auto_xla_fallback event, (b) count in the package-wide fallback
-    registry, and (c) log a warning once per shape."""
-    from distributed_tensorflow_guide_tpu.ops import flash_attention as F
+def test_ring_auto_selects_measured_winner(ctx_mesh):
+    """impl='auto' must select the XLA blockwise path — the on-chip winner
+    at every measured length (round-5 battery: Pallas at 0.157–0.487x of
+    XLA at seq 1k/2k/4k) — even for lane-aligned shapes the kernel could
+    run. The two paths share the forward trace signature (2 ppermute
+    sites), so the pin is the GRAD trace: the Pallas path's hand-written
+    backward issues 5 more wrapper-visible ppermute sites, while the XLA
+    path's backward comes from autodiff transposes that bypass the
+    wrappers — auto must show the XLA signature."""
 
-    s = 4 * 96  # S_local = 96: not a multiple of 128
-    x = jnp.zeros((B, s, H, D), jnp.float32)
-
-    def make_sm():  # fresh closure -> fresh trace (jit caches per function)
-        return jax.shard_map(
-            functools.partial(ring_attention, causal=True, impl="auto"),
+    def grad_sites(impl, s):
+        x = jnp.zeros((B, s, H, D), jnp.float32)
+        sm = shard_map(
+            functools.partial(ring_attention, causal=True, impl=impl),
             mesh=ctx_mesh,
             in_specs=(P(None, "context"),) * 3,
             out_specs=P(None, "context"),
             check_vma=False,
         )
 
-    F._FALLBACKS.clear()
-    key = ("ring_attention.auto", 96, D, F.LANE, F.LANE)
-    with caplog.at_level("WARNING", logger="dtg.ops.flash"):
+        def loss(q, k, v):
+            return jnp.sum(sm(q, k, v).astype(jnp.float32))
+
         with cc.trace_comm() as rec:
-            jax.jit(make_sm()).lower(x, x, x)
-        assert rec.calls["ring_auto_xla_fallback[context]"] == 1, dict(rec.calls)
-        # the XLA path's rotation sites confirm the fallback really ran
-        assert rec.calls["ppermute[context]"] == 2
-        assert F.fallback_stats()[key] == 1
-        n_warn = sum("falling back" in r.message for r in caplog.records)
-        assert n_warn == 1
-        # a RETRACE of the same shape stamps its trace and counts again,
-        # but does not re-warn (log-once per shape)
-        with cc.trace_comm() as rec2:
-            jax.jit(make_sm()).lower(x, x, x)
-        assert rec2.calls["ring_auto_xla_fallback[context]"] == 1
-        assert F.fallback_stats()[key] == 2
-        n_warn = sum("falling back" in r.message for r in caplog.records)
-        assert n_warn == 1
-    # aligned shapes stay on the kernel path with no event
-    xa = jnp.zeros((B, 4 * 128, H, D), jnp.float32)
-    with cc.trace_comm() as rec3:
-        jax.jit(make_sm()).lower(xa, xa, xa)
-    assert "ring_auto_xla_fallback[context]" not in rec3.calls
+            jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(x, x, x)
+        return rec.calls["ppermute[context]"]
+
+    aligned = 4 * 128   # the kernel COULD run here; auto must still say xla
+    assert grad_sites("pallas", aligned) == 7
+    assert grad_sites("xla", aligned) == 2
+    assert grad_sites("auto", aligned) == 2
+    # non-aligned shapes: auto runs xla too (and pallas refuses, pinned in
+    # test_attention.py) — no silent path switch in either direction
+    assert grad_sites("auto", 4 * 96) == 2

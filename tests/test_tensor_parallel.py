@@ -276,4 +276,7 @@ def test_megatron_sp_rules_bind_and_match():
         "all-reduce", "all-gather", "reduce-scatter", "collective-permute")}
     assert fp(txt_tp) != fp(txt_sp), "SP rules compiled to the same program"
     assert fp(txt_sp)["all-gather"] > 0  # the SP boundary gather exists
-    np.testing.assert_allclose(losses_tp, losses_sp, rtol=1e-5)
+    # rtol matches the repo's cross-topology tier (utils/determinism.py):
+    # the SP layout legitimately reorders the boundary reductions
+    # (allreduce vs gather/scatter pair), so bit-level equality is not owed
+    np.testing.assert_allclose(losses_tp, losses_sp, rtol=1e-4)
