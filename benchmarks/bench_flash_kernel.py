@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Kernel-only flash/carry microbench: per-kernel tok/s + roofline fractions.
+
+The round-5 battery measured the flash training path at MFU 0.155 (seq
+1024) and the ring carry kernel at 0.157-0.487x of the XLA path — but only
+as whole-model aggregates, so WHICH kernel starves was invisible. This
+bench times each Pallas kernel alone (fwd, dq, dkv, ring carry-step) at
+its autotune-table blocks and reports, per kernel, tokens/sec plus the
+fraction of the chip's FLOP and HBM rooflines (models in
+ops/autotune.py: MXU flops over live causal blocks; minimal algorithmic
+bytes, so block-induced re-reads read as a LOW hbm fraction — the tuning
+signal).
+
+``--tune`` first sweeps the candidate block grid per kernel and records
+the winners into the persistent autotune table — after which every flash/
+carry call site in the package picks them up automatically.
+
+Default shape = the battery's ``gpt2_flash_seq1024`` attention geometry
+(b=1 microbatch, 12 heads, seq 1024, head_dim 64, bf16).
+
+Off-TPU this prints an explicit skip line (rc=0) — kernel timings are
+meaningless in interpret mode; ``--fake-devices 1 --small`` runs the
+interpret-mode liveness check the smoke suite uses.
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.common import device_setup, report, roofline_extras
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1,
+                    help="the flash battery config runs microbatch 1")
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--dtype", choices=["bfloat16", "float32"],
+                    default="bfloat16")
+    ap.add_argument("--non-causal", action="store_true")
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--kernels", nargs="+", default=None,
+                    help="subset of fwd/dq/dkv/carry kernels")
+    ap.add_argument("--tune", action="store_true",
+                    help="sweep candidate blocks per kernel and record the "
+                         "winners into the autotune table first")
+    ap.add_argument("--tune-seqs", type=int, nargs="+", default=None,
+                    help="with --tune: ALSO sweep these sequence lengths "
+                         "(the table keys on s exactly — the battery passes "
+                         "1024 2048 4096 so the gpt2_flash rows AND the "
+                         "single-chip ring rows, whose carry/dq/dkv run at "
+                         "s_local = seq, all hit tuned entries). The "
+                         "measured report below still uses --seq-len")
+    ap.add_argument("--small", action="store_true",
+                    help="tiny interpret-friendly geometry (CPU liveness)")
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="run in interpret mode off-TPU instead of skipping")
+    ap.add_argument("--fake-devices", type=int, default=0)
+    args = ap.parse_args()
+
+    device_setup(args.fake_devices)
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu"
+    if not on_tpu and not (args.fake_devices or args.allow_cpu):
+        # explicit skip, not rc=1: the battery records it as skipped
+        print(json.dumps({
+            "metric": "flash_kernel_roofline",
+            "value": None,
+            "unit": "tokens/sec",
+            "vs_baseline": None,
+            "skipped": f"no TPU transport (backend={platform}); kernel-only "
+                       "timings are meaningless in interpret mode — use "
+                       "--fake-devices 1 --small for the liveness check",
+        }))
+        return
+
+    from distributed_tensorflow_guide_tpu.ops import autotune
+
+    b, h, s, d = args.batch, args.heads, args.seq_len, args.head_dim
+    iters, causal = args.iters, not args.non_causal
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if args.small:
+        b, h, s, d, iters = 1, 2, 256, 64, min(iters, 2)
+
+    names = {"fwd": "flash_fwd", "dq": "flash_dq", "dkv": "flash_dkv",
+             "carry": "carry_step"}
+    todo = args.kernels or list(names)
+    unknown = set(todo) - set(names)
+    if unknown:
+        sys.exit(f"unknown kernels {sorted(unknown)} (choose from "
+                 f"{sorted(names)})")
+
+    tune_seqs = []
+    if args.tune and on_tpu:
+        tune_seqs = sorted(set(args.tune_seqs or []) | {s})
+
+    for short in todo:
+        kernel = names[short]
+        kw = dict(b=b, h=h, s=s, d=d, dtype=dtype)
+        for s_t in tune_seqs:
+            autotune.ensure_tuned(kernel, b=b, h=h, s=s_t, d=d,
+                                  dtype=dtype, causal=causal,
+                                  iters=max(5, iters // 4))
+        # after a tune the report shape's lookup is a hit; otherwise the
+        # table entry (if any) or the tested default
+        blocks = autotune.blocks_for(kernel, causal=causal, **kw)
+        fn = autotune.make_kernel_runner(kernel, blocks, causal=causal, **kw)
+        secs = autotune.measure_runner(fn, iters=iters)
+        flops = autotune.kernel_flops(kernel, b=b, h=h, s=s, d=d,
+                                      blocks=blocks, causal=causal)
+        hbm = autotune.kernel_hbm_bytes(kernel, b=b, h=h, s=s, d=d,
+                                        dtype=dtype)
+        report(f"flash_kernel_{short}", b * s / secs, "tokens/sec",
+               blk_q=blocks[0], blk_k=blocks[1], batch=b, heads=h,
+               seq_len=s, head_dim=d, dtype=args.dtype, causal=causal,
+               secs_per_call=round(secs, 6), tuned=bool(args.tune and on_tpu),
+               **roofline_extras(flops, hbm, 1, secs))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,13 +39,15 @@ def main() -> None:
                          ">= 1024 (flash's O(S) memory is the long-context "
                          "capability; the old dense-fails-to-compile claim "
                          "was disproved by repro_dense_attn.py on-chip)")
-    ap.add_argument("--schedule", choices=["gpipe", "1f1b"], default="1f1b",
-                    help="microbatch schedule; 1f1b caps in-flight "
-                         "activations at the pipeline depth (its value at "
-                         "pipe >= 2), but at pipe=1 its manual-VJP "
-                         "machinery is pure overhead — round-5 battery: "
-                         "GPipe 99.7k vs 1F1B 87.9k tok/s at the default "
-                         "shape, so GPipe is the single-chip record config")
+    ap.add_argument("--schedule", choices=["auto", "gpipe", "1f1b"],
+                    default="auto",
+                    help="microbatch schedule; 'auto' (default) picks "
+                         "GPipe at pipe=1 and 1F1B at pipe>=2 — at one "
+                         "stage the 1F1B manual-VJP machinery is pure "
+                         "overhead (round-5 battery: GPipe 99.7k vs 1F1B "
+                         "87.9k tok/s at the default shape), at multiple "
+                         "stages 1F1B's O(P) activation cap is the point. "
+                         "The resolved pick is echoed in the JSON line")
     ap.add_argument("--virtual-chunks", type=int, default=1,
                     help="interleaved pipelining: layer chunks per device "
                          "(bubble shrinks ~v-fold); with --schedule 1f1b "
@@ -144,7 +146,8 @@ def main() -> None:
     dt, _ = time_steps(step2, (opt_state, params), tokens, steps=args.steps)
 
     opt_steps = args.steps * args.steps_per_call
-    extra = {}
+    # pp.schedule is the RESOLVED schedule (--schedule auto picks per mesh)
+    extra = {"schedule": pp.schedule}
     if args.steps_per_call > 1:
         extra["steps_per_call"] = args.steps_per_call
     if args.no_remat:

@@ -225,6 +225,36 @@ _TPU_BF16_PEAK: dict[str, float] = {
 # The NCCL baseline part (BASELINE.json: 8xA100). 312 TF dense bf16/chip.
 A100_BF16_PEAK = 312e12
 
+# Per-chip HBM bandwidth from the public spec sheets, same device_kind
+# substring keying as the FLOP table. The v5e's 819 GB/s is the number the
+# ResNet roofline trace already validated (docs/performance.md: backward
+# convs sustain 88-96% of it).
+_TPU_HBM_PEAK: dict[str, float] = {
+    "v5 lite": 819e9, "v5litepod": 819e9, "v5e": 819e9,
+    "v5p": 2765e9,
+    "v6 lite": 1638e9, "v6e": 1638e9,
+    "v4": 1228e9,
+    "v3": 900e9,
+    "v2": 700e9,
+}
+
+
+def _device_peak(table: dict[str, float]) -> float | None:
+    """device_kind-keyed peak lookup shared by the FLOP and HBM tables —
+    one matcher, so a device_kind naming quirk can never make the two
+    roofline fractions disagree on the same chip. None off-TPU (no CPU
+    "peak": fractions only mean something on real hardware)."""
+    import jax
+
+    d = jax.devices()[0]
+    if d.platform != "tpu":
+        return None
+    kind = d.device_kind.lower()
+    for key, peak in table.items():
+        if key in kind:
+            return peak
+    return None
+
 
 def device_peak_flops() -> float | None:
     """Dense bf16 peak of the attached accelerator, or None off-TPU.
@@ -233,16 +263,41 @@ def device_peak_flops() -> float | None:
     against a CPU "peak" would be noise, so report() callers emit MFU keys
     only on real hardware.
     """
-    import jax
+    return _device_peak(_TPU_BF16_PEAK)
 
-    d = jax.devices()[0]
-    if d.platform != "tpu":
-        return None
-    kind = d.device_kind.lower()
-    for key, peak in _TPU_BF16_PEAK.items():
-        if key in kind:
-            return peak
-    return None
+
+def device_hbm_peak() -> float | None:
+    """HBM bandwidth (bytes/s) of the attached accelerator, or None
+    off-TPU — same contract as :func:`device_peak_flops`."""
+    return _device_peak(_TPU_HBM_PEAK)
+
+
+def roofline_extras(flops_per_step: float | None,
+                    hbm_bytes_per_step: float | None,
+                    steps: int, dt: float, n_devices: int = 1) -> dict:
+    """Extra report() keys for roofline-honest benches: achieved TFLOP/s
+    and/or HBM GB/s from the caller's per-step models, plus the fraction of
+    the attached part's peak (keys emitted only on real hardware, like
+    :func:`mfu_extras`). The byte model is the caller's MINIMAL algorithmic
+    traffic — so ``hbm_roofline_frac`` is an efficiency measure: re-reads
+    the kernel/program performs beyond the ideal push it DOWN, which is
+    the tuning signal, not an accounting error."""
+    out: dict = {}
+    if flops_per_step:
+        achieved_f = flops_per_step * steps / dt
+        out["tflops_per_sec"] = round(achieved_f / 1e12, 3)
+        peak_f = device_peak_flops()
+        if peak_f:
+            out["flop_roofline_frac"] = round(
+                achieved_f / (peak_f * n_devices), 4)
+    if hbm_bytes_per_step:
+        achieved_b = hbm_bytes_per_step * steps / dt
+        out["hbm_gb_per_s"] = round(achieved_b / 1e9, 2)
+        peak_b = device_hbm_peak()
+        if peak_b:
+            out["hbm_roofline_frac"] = round(
+                achieved_b / (peak_b * n_devices), 4)
+    return out
 
 
 def lm_model_flops_per_step(cfg, global_batch: int) -> float:

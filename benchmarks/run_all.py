@@ -25,6 +25,7 @@ BENCHES = [
     "bench_fsdp_memory.py",   # FSDP: per-device state bytes vs replicated DP
     "bench_sp_comm.py",       # SP layouts: ring vs Ulysses ICI traffic
     "bench_generate.py",      # serving: KV-cache decode tokens/sec
+    "bench_flash_kernel.py",  # kernel-only flash/carry roofline fractions
 ]
 
 # Tiny fake-device configs, small enough for CPU (also used by
@@ -72,7 +73,12 @@ SMOKE = {
          "--steps", "3", "--image-size", "64", "--augment"],
     "bench_generate.py":
         ["--fake-devices", "1", "--small", "--batch", "2",
-         "--prompt-len", "16", "--max-new", "8", "--iters", "2"],
+         "--prompt-len", "16", "--max-new", "8", "--iters", "2",
+         "--unroll", "2"],
+    "bench_flash_kernel.py":
+        # interpret-mode liveness: every kernel (fwd/dq/dkv/carry) runs end
+        # to end and emits its roofline-model keys; timings meaningless
+        ["--fake-devices", "1", "--small"],
 }
 
 

@@ -1,0 +1,476 @@
+"""Kernel block-size autotuning + roofline accounting for the Pallas tier.
+
+The round-5 on-chip battery showed the hand-written kernels are the repo's
+biggest perf liability (flash training MFU 0.155 at seq 1024 vs 0.35–0.40
+dense; the ring carry kernel at 0.157–0.487x of the XLA path it was built
+to beat). Both FlashAttention (Dao et al. 2022) and Ring Attention (Liu et
+al. 2023) report these kernels are block-size- and memory-traffic-
+sensitive — yet every call site hardcoded ``blk_q = blk_k = 128``. This
+module removes the hardcode:
+
+* a **persistent tuning table** keyed on (kernel, shape, dtype, platform):
+  ``blocks_for`` is what call sites ask (never sweeps, never writes — the
+  tested ``DEFAULT_BLOCKS`` fallback on a miss); ``ensure_tuned`` sweeps
+  the candidate grid ON CHIP and records the winner (exact-shape entry
+  plus a batch/head-generic one, so one capture serves nearby batches);
+* a **per-kernel sweep harness**: the four kernels (forward, dq, dkv,
+  ring carry-step) are measured SEPARATELY — their arithmetic
+  intensities differ (2/3/4 MXU passes per block pair), so one shared
+  block choice was never right;
+* the **FLOP / HBM roofline models** the kernel-only microbench
+  (benchmarks/bench_flash_kernel.py) reports fractions against.
+
+Hermeticity contract (tier-1 CI): under ``JAX_PLATFORMS=cpu`` this module
+is a *defaults-only path* — it never reads or writes the table file and
+refuses to sweep (interpret-mode timings are meaningless, and a stray
+table on the host must not change which kernel programs CI traces).
+Pinned by tests/test_autotune.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from pathlib import Path
+from typing import Callable, NamedTuple
+
+KERNELS = ("flash_fwd", "flash_dq", "flash_dkv", "carry_step")
+
+# The tested fallback every call site gets on a table miss — the historical
+# hardcode, now the one definition it reduces to.
+DEFAULT_BLOCKS: tuple[int, int] = (128, 128)
+
+LANE = 128  # TPU lane width; block edges must be sublane (8) multiples
+
+# Block-edge candidates for the sweep, filtered per shape by divisibility
+# and the VMEM working-set budget below.
+CANDIDATE_EDGES = (64, 128, 256, 512, 1024)
+
+# Per-grid-cell VMEM working-set budget. ~16 MB/core physically; half of it
+# keeps headroom for Mosaic's own temporaries and the double-buffered
+# pipeline the estimate already models.
+VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+class FlashBlocks(NamedTuple):
+    """Per-kernel (blk_q, blk_k) for one flash_attention call — the unit
+    the custom_vjp carries as a static argument."""
+
+    fwd: tuple[int, int]
+    dq: tuple[int, int]
+    dkv: tuple[int, int]
+
+
+_lock = threading.Lock()
+_mem: dict[str, dict] = {}  # in-memory table; file merged in lazily
+_loaded_from: str | None = None
+
+
+def _platform(platform: str | None = None) -> str:
+    """The table's platform key. On TPU this includes the device_kind
+    (e.g. ``tpu:tpu-v5-lite``) — block winners are a VMEM/MXU-balance
+    property of the GENERATION, so a v5e-tuned table must miss (and fall
+    back to defaults / re-sweep) on a v4/v6e sharing the same home dir,
+    same keying discipline as benchmarks/common.py's peak tables."""
+    if platform is not None:
+        return platform
+    import jax
+
+    backend = jax.default_backend()
+    if backend != "tpu":
+        return backend
+    kind = jax.devices()[0].device_kind.lower().replace(" ", "-")
+    return f"tpu:{kind}"
+
+
+def table_path() -> Path:
+    """Where the table persists: $DTG_AUTOTUNE_TABLE, else the user cache
+    (NOT the repo — tuning state is machine state, like the XLA compile
+    cache)."""
+    env = os.environ.get("DTG_AUTOTUNE_TABLE")
+    if env:
+        return Path(env)
+    return Path(os.path.expanduser("~/.cache/dtg_autotune/table_v1.json"))
+
+
+def _dtype_name(dtype) -> str:
+    import numpy as np
+
+    try:
+        return np.dtype(dtype).name
+    except TypeError:
+        return str(dtype)
+
+
+def _key(kernel: str, b: int, h: int, s: int, d: int, dtype: str,
+         causal: bool, platform: str) -> str:
+    # causal is part of the key: the masking regime changes each
+    # candidate's live-block count and therefore its winner — blocks
+    # tuned under one regime must not silently govern the other
+    mode = "causal" if causal else "full"
+    return f"{kernel}|b{b}|h{h}|s{s}|d{d}|{dtype}|{mode}|{platform}"
+
+
+def reset() -> None:
+    """Drop the in-memory table (tests; the next TPU lookup reloads)."""
+    global _loaded_from
+    with _lock:
+        _mem.clear()
+        _loaded_from = None
+
+
+def _maybe_load(platform: str) -> None:
+    """Merge the persisted table into memory — never on CPU (hermeticity
+    contract in the module docstring)."""
+    global _loaded_from
+    if platform == "cpu":
+        return
+    path = table_path()
+    with _lock:
+        if _loaded_from == str(path):
+            return
+        try:
+            data = json.loads(path.read_text())
+        except (OSError, ValueError):
+            data = {}
+        for k, v in data.items():
+            _mem.setdefault(k, v)  # in-memory entries win
+        _loaded_from = str(path)
+
+
+def _valid(blocks: tuple[int, int], s: int) -> bool:
+    bq, bk = blocks
+    return (bq > 0 and bk > 0 and bq % 8 == 0 and bk % 8 == 0
+            and s % bq == 0 and s % bk == 0)
+
+
+def lookup(kernel: str, *, b: int, h: int, s: int, d: int, dtype,
+           causal: bool = True,
+           platform: str | None = None) -> tuple[int, int] | None:
+    """Tuned (blk_q, blk_k) for the key, or None. Tries the exact shape,
+    then the batch/head-generic entry the sweep also records. Entries that
+    no longer divide the shape are ignored (stale-table safety)."""
+    if kernel not in KERNELS:
+        raise ValueError(f"unknown kernel {kernel!r} (one of {KERNELS})")
+    plat = _platform(platform)
+    _maybe_load(plat)
+    dt = _dtype_name(dtype)
+    for key in (_key(kernel, b, h, s, d, dt, causal, plat),
+                _key(kernel, 0, 0, s, d, dt, causal, plat)):
+        ent = _mem.get(key)
+        if ent:
+            blocks = (int(ent["blk_q"]), int(ent["blk_k"]))
+            if _valid(blocks, s):
+                return blocks
+    return None
+
+
+def blocks_for(kernel: str, *, b: int, h: int, s: int, d: int, dtype,
+               causal: bool = True,
+               platform: str | None = None) -> tuple[int, int]:
+    """The block sizes a call site should use: the tuned entry when one
+    exists, else ``DEFAULT_BLOCKS``. Never sweeps, never writes — safe at
+    trace time on any platform."""
+    hit = lookup(kernel, b=b, h=h, s=s, d=d, dtype=dtype, causal=causal,
+                 platform=platform)
+    return hit if hit is not None else DEFAULT_BLOCKS
+
+
+def record(kernel: str, *, b: int, h: int, s: int, d: int, dtype,
+           blocks: tuple[int, int], detail: dict | None = None,
+           causal: bool = True,
+           platform: str | None = None, generalize: bool = True) -> None:
+    """Write one tuning entry (exact key + the batch/head-generic key) and
+    persist the table. Refused on CPU — see the hermeticity contract."""
+    plat = _platform(platform)
+    if plat == "cpu":
+        raise RuntimeError(
+            "autotune.record refused on the CPU platform: tier-1 CI is a "
+            "defaults-only path (no table writes, no sweeps) so its traced "
+            "programs never depend on ambient tuning state")
+    blocks = (int(blocks[0]), int(blocks[1]))
+    if not _valid(blocks, s):
+        raise ValueError(f"blocks {blocks} invalid for seq {s} "
+                         "(need sublane multiples that divide s)")
+    _maybe_load(plat)
+    dt = _dtype_name(dtype)
+    ent: dict = {"blk_q": blocks[0], "blk_k": blocks[1]}
+    if detail:
+        ent["detail"] = detail
+    with _lock:
+        _mem[_key(kernel, b, h, s, d, dt, causal, plat)] = ent
+        if generalize:
+            _mem[_key(kernel, 0, 0, s, d, dt, causal, plat)] = dict(ent)
+        path = table_path()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(json.dumps(_mem, indent=1, sort_keys=True))
+        os.replace(tmp, path)
+
+
+# --------------------------------------------------------------------------
+# roofline models (shared by the sweep, the microbench, and the tests)
+# --------------------------------------------------------------------------
+
+
+def padded_head_dim(d: int) -> int:
+    return -(-d // LANE) * LANE
+
+
+def live_block_count(s: int, blk_q: int, blk_k: int, causal: bool) -> int:
+    """Grid cells that actually compute: causal kernels skip every KV block
+    strictly above the Q block's diagonal (pl.when), so dead cells cost
+    neither FLOPs nor (meaningful) bandwidth."""
+    n_q, n_kv = s // blk_q, s // blk_k
+    if not causal:
+        return n_q * n_kv
+    return sum(1 for i in range(n_q) for j in range(n_kv)
+               if j * blk_k <= i * blk_q + blk_q - 1)
+
+
+# MXU matmuls per live (Q-block, KV-block) pair: fwd/carry do qk^T + p.v;
+# dq adds ds.k; dkv does qk^T + p^T.do + do.v^T + ds^T.q.
+_MXU_PASSES = {"flash_fwd": 2, "carry_step": 2, "flash_dq": 3,
+               "flash_dkv": 4}
+
+
+def kernel_flops(kernel: str, *, b: int, h: int, s: int, d: int,
+                 blocks: tuple[int, int], causal: bool = True) -> float:
+    """Hardware MXU FLOPs of ONE kernel call: 2*M*N*K per matmul over the
+    PADDED head dim (what the MXU executes), live causal blocks only."""
+    bq, bk = blocks
+    dp = padded_head_dim(d)
+    live = live_block_count(s, bq, bk, causal)
+    return 2.0 * _MXU_PASSES[kernel] * bq * bk * dp * live * b * h
+
+
+def kernel_hbm_bytes(kernel: str, *, b: int, h: int, s: int, d: int,
+                     dtype) -> float:
+    """Minimal algorithmic HBM traffic of ONE call: every operand read
+    once, every output written once (perfect on-chip reuse). The roofline
+    fraction against this is a kernel-efficiency measure — block-induced
+    re-reads (e.g. K/V fetched once per Q block) show up as a LOW
+    fraction, which is exactly the signal the tuner chases."""
+    import numpy as np
+
+    io = np.dtype(dtype).itemsize
+    dp = padded_head_dim(d)
+    t = b * h * s * dp      # one head-dim-sized tensor
+    lane = b * h * s * LANE  # one lane-broadcast softmax stat (always f32)
+    if kernel == "flash_fwd":       # read q,k,v; write o + lse
+        return 4 * t * io + lane * 4
+    if kernel == "carry_step":      # read q,k,v + (m,l,acc); write (m,l,acc)
+        return 3 * t * io + 2 * (2 * lane + t) * 4
+    if kernel == "flash_dq":        # read q,k,v,do + lse,delta; write dq
+        return 5 * t * io + 2 * lane * 4
+    if kernel == "flash_dkv":       # read q,k,v,do + lse,delta; write dk,dv
+        return 6 * t * io + 2 * lane * 4
+    raise ValueError(f"unknown kernel {kernel!r}")
+
+
+def kernel_vmem_bytes(kernel: str, blk_q: int, blk_k: int, dp: int,
+                      dtype) -> int:
+    """Per-grid-cell VMEM working set: in/out tiles (double-buffered by the
+    Pallas pipeline, hence x2) + f32 scratch + the (blk_q, blk_k) f32
+    score/probability temporaries the kernel body materializes (s and p
+    for fwd/carry; s, p, dp and ds for the backward kernels — the
+    DOMINANT term at large blocks). Used to filter sweep candidates."""
+    import numpy as np
+
+    io = np.dtype(dtype).itemsize
+    q_t, k_t, l_t = blk_q * dp, blk_k * dp, blk_q * LANE
+    score = blk_q * blk_k * 4
+    if kernel == "flash_fwd":
+        tiles = (2 * q_t + 2 * k_t) * io + l_t * 4
+        scratch = (2 * l_t + q_t) * 4
+        body = 2 * score
+    elif kernel == "carry_step":
+        tiles = (q_t + 2 * k_t) * io + 2 * (2 * l_t + q_t) * 4
+        scratch = (2 * l_t + q_t) * 4
+        body = 2 * score
+    elif kernel == "flash_dq":
+        tiles = (3 * q_t + 2 * k_t) * io + 2 * l_t * 4
+        scratch = q_t * 4
+        body = 4 * score
+    elif kernel == "flash_dkv":
+        tiles = (2 * q_t + 4 * k_t) * io + 2 * l_t * 4
+        scratch = 2 * k_t * 4
+        body = 4 * score
+    else:
+        raise ValueError(f"unknown kernel {kernel!r}")
+    return 2 * tiles + scratch + body
+
+
+def candidate_blocks(kernel: str, *, s: int, d: int,
+                     dtype) -> list[tuple[int, int]]:
+    """The sweep grid for one kernel/shape: candidate edges that divide the
+    sequence and fit the VMEM budget."""
+    dp = padded_head_dim(d)
+    edges = [e for e in CANDIDATE_EDGES if e <= s and s % e == 0]
+    return [
+        (bq, bk)
+        for bq in edges for bk in edges
+        if kernel_vmem_bytes(kernel, bq, bk, dp, dtype) <= VMEM_BUDGET_BYTES
+    ]
+
+
+# --------------------------------------------------------------------------
+# kernel runners + the sweep
+# --------------------------------------------------------------------------
+
+
+def kernel_operands(kernel: str, *, b: int, h: int, s: int, d: int, dtype,
+                    causal: bool = True, seed: int = 0) -> tuple:
+    """Kernel-layout operands for one runner — split out from
+    :func:`make_kernel_runner` so a SWEEP builds them (and the backward
+    residual forward pass, a full kernel compile+run) ONCE per
+    (kernel, shape), not once per swept candidate."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_guide_tpu.ops import flash_attention as F
+
+    dp = padded_head_dim(d)
+    scale = 1.0 / (d ** 0.5)
+    keys = jax.random.split(jax.random.PRNGKey(seed), 4)
+
+    def mk(k_):
+        x = jax.random.normal(k_, (b, h, s, dp), jnp.float32)
+        if dp != d:  # padding lanes are zero, as the public API guarantees
+            x = x.at[..., d:].set(0.0)
+        return x.astype(dtype)
+
+    q, k, v, do = (mk(k_) for k_ in keys)
+    if kernel == "flash_fwd":
+        return (q, k, v)
+    if kernel == "carry_step":
+        return (q, k, v, *F.carry_init(b, h, s, dp))
+    if kernel in ("flash_dq", "flash_dkv"):
+        # backward residuals from the forward at the DEFAULT blocks, so
+        # every candidate times identical operands
+        dbq, dbk = DEFAULT_BLOCKS
+        out, lse = jax.jit(lambda q, k, v: F._fwd_call(
+            q, k, v, scale=scale, causal=causal,
+            blk_q=dbq, blk_k=dbk))(q, k, v)
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), -1)
+        delta_b = jnp.broadcast_to(delta[..., None], (b, h, s, LANE))
+        return (q, k, v, do, lse, delta_b)
+    raise ValueError(f"unknown kernel {kernel!r}")
+
+
+def make_kernel_runner(kernel: str, blocks: tuple[int, int], *, b: int,
+                       h: int, s: int, d: int, dtype, causal: bool = True,
+                       seed: int = 0,
+                       operands: tuple | None = None) -> Callable[[], object]:
+    """A zero-arg callable running ONE raw kernel call at ``blocks`` on
+    kernel-layout operands — the unit both the sweep and the kernel-only
+    microbench time. Pass ``operands`` (from :func:`kernel_operands`) to
+    share them across candidates; built here when omitted."""
+    import jax
+
+    from distributed_tensorflow_guide_tpu.ops import flash_attention as F
+
+    bq, bk = blocks
+    scale = 1.0 / (d ** 0.5)
+    if operands is None:
+        operands = kernel_operands(kernel, b=b, h=h, s=s, d=d, dtype=dtype,
+                                   causal=causal, seed=seed)
+    if kernel == "flash_fwd":
+        f = jax.jit(lambda q, k, v: F._fwd_call(
+            q, k, v, scale=scale, causal=causal, blk_q=bq, blk_k=bk))
+    elif kernel == "carry_step":
+        f = jax.jit(lambda *a: F.flash_carry_step(
+            *a, scale=scale, diag=causal, blk_q=bq, blk_k=bk))
+    elif kernel == "flash_dq":
+        f = jax.jit(lambda *a: F._bwd_dq_call(
+            *a, scale=scale, causal=causal, blk_q=bq, blk_k=bk))
+    elif kernel == "flash_dkv":
+        f = jax.jit(lambda *a: F._bwd_dkv_call(
+            *a, scale=scale, causal=causal, blk_q=bq, blk_k=bk))
+    else:
+        raise ValueError(f"unknown kernel {kernel!r}")
+    return lambda: f(*operands)
+
+
+def measure_runner(fn: Callable[[], object], *, iters: int = 20,
+                   warmup: int = 2) -> float:
+    """Seconds per call, timed-region closed by a VALUE fetch (the
+    benchmarks/common.py finding: block_until_ready under-synchronizes on
+    the tunnel transport; a value fetch cannot complete early)."""
+    import time
+
+    import jax
+    import numpy as np
+
+    out = None
+    for _ in range(max(1, warmup)):
+        out = fn()
+    jax.block_until_ready(out)
+    np.asarray(jax.tree.leaves(out)[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    np.asarray(jax.tree.leaves(out)[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def ensure_tuned(kernel: str, *, b: int, h: int, s: int, d: int, dtype,
+                 causal: bool = True, iters: int = 20,
+                 measure: Callable | None = None,
+                 platform: str | None = None) -> tuple[int, int]:
+    """Tuned blocks for the key — from the table when present (same key →
+    same blocks, NO re-sweep), else sweep-and-record. ``measure(kernel,
+    blocks) -> secs_per_call`` is injectable for tests; the default times
+    the real kernel via :func:`make_kernel_runner`. Refused on CPU."""
+    hit = lookup(kernel, b=b, h=h, s=s, d=d, dtype=dtype, causal=causal,
+                 platform=platform)
+    if hit is not None:
+        return hit
+    plat = _platform(platform)
+    if plat == "cpu":
+        raise RuntimeError(
+            "autotune sweep refused on the CPU platform (defaults-only "
+            "path): interpret-mode timings are meaningless and tier-1 CI "
+            "must stay hermetic — use blocks_for() for the fallback blocks")
+    cands = candidate_blocks(kernel, s=s, d=d, dtype=dtype)
+    if not cands:
+        return blocks_for(kernel, b=b, h=h, s=s, d=d, dtype=dtype,
+                          causal=causal, platform=plat)
+    if measure is None:
+        ops = kernel_operands(kernel, b=b, h=h, s=s, d=d, dtype=dtype,
+                              causal=causal)  # once per sweep, not per cand
+
+        def measure(kern, blocks):  # noqa: F811 - documented injection point
+            fn = make_kernel_runner(kern, blocks, b=b, h=h, s=s, d=d,
+                                    dtype=dtype, causal=causal,
+                                    operands=ops)
+            return measure_runner(fn, iters=iters)
+
+    # Per-candidate failure isolation: the VMEM model is an estimate, and
+    # one RESOURCE_EXHAUSTED compile must cost one candidate, not the
+    # whole battery row (and not the later kernels' sweeps).
+    timed: dict[tuple[int, int], float] = {}
+    failed: list[dict] = []
+    for blocks in cands:
+        try:
+            timed[blocks] = float(measure(kernel, blocks))
+        except Exception as e:  # noqa: BLE001 - record and move on
+            failed.append({"blk_q": blocks[0], "blk_k": blocks[1],
+                           "error": str(e)[:200]})
+    if not timed:
+        return blocks_for(kernel, b=b, h=h, s=s, d=d, dtype=dtype,
+                          causal=causal, platform=plat)
+    best = min(timed, key=timed.get)
+    detail = {
+        "iters": iters, "causal": causal,
+        "swept": [
+            {"blk_q": bq, "blk_k": bk, "secs_per_call": round(t, 7)}
+            for (bq, bk), t in sorted(timed.items())
+        ],
+    }
+    if failed:
+        detail["failed"] = failed
+    record(kernel, b=b, h=h, s=s, d=d, dtype=dtype, blocks=best,
+           detail=detail, causal=causal, platform=plat)
+    return best

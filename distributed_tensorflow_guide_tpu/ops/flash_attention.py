@@ -19,6 +19,12 @@ transposes to (B, H, S, D) for the kernel so the (S, D) tiles are MXU-shaped.
 Head dim is zero-padded to a lane multiple (128); zero columns are exact
 no-ops through q·kᵀ and the p·v contraction, and are sliced off on return.
 
+Block sizes are NOT hardcoded: each kernel (fwd, dq, dkv, and the ring
+carry step) resolves its own (blk_q, blk_k) from the autotune table
+(ops/autotune.py — swept on chip by ``bench_flash_kernel.py --tune``,
+tested 128x128 default on a miss; explicit ``blk_q``/``blk_k`` arguments
+pin it, which is what the parity tests and the sweep itself use).
+
 On CPU (tests, dryrun) the same kernels run via ``interpret=True``.
 """
 
@@ -37,6 +43,12 @@ try:  # pltpu imports only resolve fully on TPU builds; interpret works anyway
 except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
+
+from distributed_tensorflow_guide_tpu.ops import autotune
+from distributed_tensorflow_guide_tpu.ops.autotune import (
+    DEFAULT_BLOCKS,
+    FlashBlocks,
+)
 
 NEG_INF = -1e30
 LANE = 128
@@ -242,13 +254,19 @@ def _carry_fwd_kernel(q_ref, k_ref, v_ref, m_in, l_in, acc_in,
 
 
 def flash_carry_step(q, k, v, m, l, acc, *, scale: float, diag: bool,
-                     blk_q: int = 128, blk_k: int = 128):
+                     blk_q: int, blk_k: int):
     """One ring-rotation visit: merge KV block (k, v) into the carry.
 
     Kernel layout: q/k/v (B, H, S, Dp); m/l (B, H, S, LANE) f32
     (lane-broadcast, same trade as _fwd_call's lse); acc (B, H, S, Dp) f32
     un-normalized. ``diag`` selects causal masking for the aligned-shard
     rotation; fully-dead rotations must be skipped by the caller.
+
+    Block sizes are REQUIRED: this function only sees the lane-PADDED head
+    dim while the autotune table keys on the logical one, so resolution
+    belongs to the caller — :func:`carry_blocks` is the one lookup path
+    (parallel/sequence.py uses it; an in-function fallback keyed on the
+    padded dim would silently miss every d < LANE entry).
     """
     b, h, s, dp = q.shape
     n_q, n_kv = s // blk_q, s // blk_k
@@ -385,15 +403,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, do, lse, delta, *, scale, causal, blk_q, blk_k):
-    """lse arrives lane-broadcast (B, H, S, LANE) straight from forward;
-    delta is (B, H, S) and broadcast once here."""
+def _bwd_dq_call(q, k, v, do, lse_b, delta_b, *, scale, causal, blk_q,
+                 blk_k):
+    """The dQ backward kernel alone — separately callable so the autotuner
+    and the kernel-only microbench can sweep/measure it apart from dK/dV
+    (its arithmetic intensity differs: 3 MXU passes per block vs 4)."""
     b, h, s, dp = q.shape
     n_q, n_kv = s // blk_q, s // blk_k
-    lse_b = lse
-    delta_b = jnp.broadcast_to(delta[..., None], (b, h, s, LANE))
-
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, blk_q=blk_q,
             blk_k=blk_k,
@@ -415,7 +432,13 @@ def _bwd_call(q, k, v, do, lse, delta, *, scale, causal, blk_q, blk_k):
         interpret=_interpret(),
     )(q, k, v, do, lse_b, delta_b)
 
-    dk, dv = pl.pallas_call(
+
+def _bwd_dkv_call(q, k, v, do, lse_b, delta_b, *, scale, causal, blk_q,
+                  blk_k):
+    """The dK/dV backward kernel alone (see _bwd_dq_call)."""
+    b, h, s, dp = q.shape
+    n_q, n_kv = s // blk_q, s // blk_k
+    return pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, blk_q=blk_q,
             blk_k=blk_k,
@@ -443,6 +466,19 @@ def _bwd_call(q, k, v, do, lse, delta, *, scale, causal, blk_q, blk_k):
         ],
         interpret=_interpret(),
     )(q, k, v, do, lse_b, delta_b)
+
+
+def _bwd_call(q, k, v, do, lse, delta, *, scale, causal, blk_dq, blk_dkv):
+    """Both backward kernels, each at its OWN tuned (blk_q, blk_k). lse
+    arrives lane-broadcast (B, H, S, LANE) straight from forward; delta is
+    (B, H, S) and broadcast once here."""
+    b, h, s, dp = q.shape
+    delta_b = jnp.broadcast_to(delta[..., None], (b, h, s, LANE))
+    dq = _bwd_dq_call(q, k, v, do, lse, delta_b, scale=scale, causal=causal,
+                      blk_q=blk_dq[0], blk_k=blk_dq[1])
+    dk, dv = _bwd_dkv_call(q, k, v, do, lse, delta_b, scale=scale,
+                           causal=causal, blk_q=blk_dkv[0],
+                           blk_k=blk_dkv[1])
     return dq, dk, dv
 
 
@@ -560,23 +596,25 @@ def _make_cp():
     )
 
     bwd_cp = custom_partitioning(
-        lambda q, k, v, do, lse, delta, scale, causal, blk_q, blk_k:
+        lambda q, k, v, do, lse, delta, scale, causal, blk_dq, blk_dkv:
         _bwd_call(q, k, v, do, lse, delta, scale=scale, causal=causal,
-                  blk_q=blk_q, blk_k=blk_k),
+                  blk_dq=blk_dq, blk_dkv=blk_dkv),
         static_argnums=(6, 7, 8, 9),
     )
 
-    def bwd_infer(scale, causal, blk_q, blk_k, mesh, arg_shapes, result_shape):
+    def bwd_infer(scale, causal, blk_dq, blk_dkv, mesh, arg_shapes,
+                  result_shape):
         s = _bh_sharding(mesh, arg_shapes[0].sharding)
         return (s, s, s)
 
-    def bwd_part(scale, causal, blk_q, blk_k, mesh, arg_shapes, result_shape):
+    def bwd_part(scale, causal, blk_dq, blk_dkv, mesh, arg_shapes,
+                 result_shape):
         s = _bh_sharding(mesh, arg_shapes[0].sharding)
         s3 = _bh_sharding(mesh, arg_shapes[0].sharding, rank=3)
 
         def lower(q, k, v, do, lse, delta):
             return _bwd_call(q, k, v, do, lse, delta, scale=scale,
-                             causal=causal, blk_q=blk_q, blk_k=blk_k)
+                             causal=causal, blk_dq=blk_dq, blk_dkv=blk_dkv)
 
         return mesh, lower, (s, s, s), (s, s, s, s, s, s3)
 
@@ -606,11 +644,13 @@ def _fwd_dispatch(q, k, v, *, scale, causal, blk_q, blk_k):
                      blk_k=blk_k)
 
 
-def _bwd_dispatch(q, k, v, do, lse, delta, *, scale, causal, blk_q, blk_k):
+def _bwd_dispatch(q, k, v, do, lse, delta, *, scale, causal, blk_dq,
+                  blk_dkv):
     if _in_auto_mesh():
-        return _BWD_CP(q, k, v, do, lse, delta, scale, causal, blk_q, blk_k)
+        return _BWD_CP(q, k, v, do, lse, delta, scale, causal, blk_dq,
+                       blk_dkv)
     return _bwd_call(q, k, v, do, lse, delta, scale=scale, causal=causal,
-                     blk_q=blk_q, blk_k=blk_k)
+                     blk_dq=blk_dq, blk_dkv=blk_dkv)
 
 
 # --------------------------------------------------------------------------
@@ -618,25 +658,25 @@ def _bwd_dispatch(q, k, v, do, lse, delta, *, scale, causal, blk_q, blk_k):
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, blk_q, blk_k):
-    out, _ = _fwd_dispatch(q, k, v, scale=scale, causal=causal, blk_q=blk_q,
-                           blk_k=blk_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, scale, causal, blocks: FlashBlocks):
+    out, _ = _fwd_dispatch(q, k, v, scale=scale, causal=causal,
+                           blk_q=blocks.fwd[0], blk_k=blocks.fwd[1])
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, blk_q, blk_k):
+def _flash_fwd_rule(q, k, v, scale, causal, blocks: FlashBlocks):
     out, lse = _fwd_dispatch(q, k, v, scale=scale, causal=causal,
-                             blk_q=blk_q, blk_k=blk_k)
+                             blk_q=blocks.fwd[0], blk_k=blocks.fwd[1])
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(scale, causal, blk_q, blk_k, res, g):
+def _flash_bwd_rule(scale, causal, blocks: FlashBlocks, res, g):
     q, k, v, out, lse = res
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     dq, dk, dv = _bwd_dispatch(
-        q, k, v, g, lse, delta, scale=scale, causal=causal, blk_q=blk_q,
-        blk_k=blk_k,
+        q, k, v, g, lse, delta, scale=scale, causal=causal,
+        blk_dq=blocks.dq, blk_dkv=blocks.dkv,
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -644,9 +684,49 @@ def _flash_bwd_rule(scale, causal, blk_q, blk_k, res, g):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def supported(s: int, d: int, blk_q: int = 128, blk_k: int = 128) -> bool:
+def flash_blocks(b: int, h: int, s: int, d: int, dtype,
+                 causal: bool = True) -> FlashBlocks:
+    """Per-kernel tuned blocks for one flash call shape — each of the three
+    kernels consults its OWN autotune entry (tested default: 128x128).
+
+    With :func:`carry_blocks` and :func:`bwd_blocks`, these helpers are
+    the ONLY lookup paths — key construction (logical head dim, dtype,
+    causal regime) lives here, never at call sites."""
+    kw = dict(b=b, h=h, s=s, d=d, dtype=dtype, causal=causal)
+    return FlashBlocks(
+        fwd=autotune.blocks_for("flash_fwd", **kw),
+        dq=autotune.blocks_for("flash_dq", **kw),
+        dkv=autotune.blocks_for("flash_dkv", **kw),
+    )
+
+
+def bwd_blocks(b: int, h: int, s: int, d: int, dtype,
+               causal: bool = True) -> tuple[tuple[int, int],
+                                             tuple[int, int]]:
+    """(blk_dq, blk_dkv) for a standalone backward call — what the ring's
+    hand-written per-visit backward (parallel/sequence.py) resolves."""
+    kw = dict(b=b, h=h, s=s, d=d, dtype=dtype, causal=causal)
+    return (autotune.blocks_for("flash_dq", **kw),
+            autotune.blocks_for("flash_dkv", **kw))
+
+
+def carry_blocks(b: int, h: int, s: int, d: int, dtype,
+                 causal: bool = True) -> tuple[int, int]:
+    """Tuned blocks for the ring carry kernel, keyed on the LOGICAL head
+    dim (the ring call sites know it; flash_carry_step itself only sees the
+    padded dim)."""
+    return autotune.blocks_for("carry_step", b=b, h=h, s=s, d=d,
+                               dtype=dtype, causal=causal)
+
+
+def supported(s: int, d: int, blk_q: int | None = None,
+              blk_k: int | None = None) -> bool:
     """Shapes the fused kernel handles; callers fall back to the pure-XLA
-    blockwise path otherwise."""
+    blockwise path otherwise. Defaults to the autotune fallback blocks."""
+    if blk_q is None:
+        blk_q = DEFAULT_BLOCKS[0]
+    if blk_k is None:
+        blk_k = DEFAULT_BLOCKS[1]
     return s % blk_q == 0 and s % blk_k == 0 and s >= max(blk_q, blk_k)
 
 
@@ -682,20 +762,31 @@ def _note_fallback(s: int, d: int, blk_q: int, blk_k: int, *,
         ))
 
 
-def flash_attention(q, k, v, *, causal: bool = False, blk_q: int = 128,
-                    blk_k: int = 128):
+def flash_attention(q, k, v, *, causal: bool = False,
+                    blk_q: int | None = None, blk_k: int | None = None):
     """Fused attention, public layout (B, S, H, D) → (B, S, H, D).
 
     Softmax scale is 1/sqrt(D) over the *logical* head dim (padding lanes
     excluded). Differentiable via hand-written backward kernels.
+
+    Block sizes: by default each of the three kernels (fwd, dq, dkv) takes
+    its own entry from the autotune table (ops/autotune.py; tested default
+    fallback 128x128). Passing ``blk_q``/``blk_k`` pins ALL kernels to that
+    one pair — the override the parity tests and the sweep use.
     """
     b, s, hn, d = q.shape
-    if not supported(s, d, blk_q, blk_k):
+    if blk_q is not None or blk_k is not None:
+        pin = (blk_q if blk_q is not None else DEFAULT_BLOCKS[0],
+               blk_k if blk_k is not None else DEFAULT_BLOCKS[1])
+        blocks = FlashBlocks(fwd=pin, dq=pin, dkv=pin)
+    else:
+        blocks = flash_blocks(b, hn, s, d, q.dtype, causal)
+    if not all(supported(s, d, *pair) for pair in blocks):
         from distributed_tensorflow_guide_tpu.ops.attention import (
             blockwise_attention,
         )
 
-        _note_fallback(s, d, blk_q, blk_k)
+        _note_fallback(s, d, *blocks.fwd)
         return blockwise_attention(q, k, v, causal=causal)
     scale = 1.0 / (d ** 0.5)
     dp = -(-d // LANE) * LANE
@@ -707,6 +798,6 @@ def flash_attention(q, k, v, *, causal: bool = False, blk_q: int = 128,
         return x
 
     out = _flash(to_kernel(q), to_kernel(k), to_kernel(v), scale, causal,
-                 blk_q, blk_k)
+                 blocks)
     out = jnp.transpose(out, (0, 2, 1, 3))
     return out[..., :d]

@@ -39,6 +39,13 @@ from distributed_tensorflow_guide_tpu.ops import flash_attention as F
 # future capture inverts it.
 RING_AUTO_IMPL = "xla"
 
+# Last measured pallas/xla throughput ratios (round-5 on-chip battery,
+# causal fwd+bwd, bf16, B=4 H=12 D=64) — what the impl="pallas" opt-in
+# warning cites, and what the next capture should overwrite. Measured with
+# the then-hardcoded 128x128 blocks; the autotune table (ops/autotune.py)
+# is the bisect instrument for closing it.
+RING_PALLAS_LAST_MEASURED = {1024: 0.157, 2048: 0.255, 4096: 0.487}
+
 
 def ring_attention(q, k, v, *, axis: str = "context", causal: bool = False,
                    impl: str = "auto"):
@@ -72,6 +79,22 @@ def ring_attention(q, k, v, *, axis: str = "context", causal: bool = False,
             f"(got S_local={s_local}); use impl='xla' or pad the sequence"
         )
     if impl == "pallas":
+        # The opt-in path must never be SILENTLY slow: one warning per
+        # shape (same once-per-shape registry as the flash fallback, so a
+        # profiling audit reads a single surface) citing the last measured
+        # pallas/xla ratio.
+        ratios = ", ".join(f"{s}: {r}x"
+                           for s, r in RING_PALLAS_LAST_MEASURED.items())
+        F._note_fallback(
+            s_local, d, 0, 0, origin="ring_attention_pallas_optin",
+            msg=(
+                "ring_attention impl='pallas' opted in: the last on-chip "
+                "capture (round-5 battery) measured the Pallas carry path "
+                f"at a fraction of the XLA path ({{seq: pallas/xla}} = "
+                f"{{{ratios}}}). Tune it first (benchmarks/"
+                "bench_flash_kernel.py --tune populates the carry_step "
+                "autotune entry) or use impl='auto'."
+            ))
         return _ring_flash_public(q, k, v, axis=axis, causal=causal)
     return _ring_xla(q, k, v, axis=axis, causal=causal)
 
@@ -145,12 +168,16 @@ def _ring_steps_fwd(q, k, v, axis, causal, scale):
     fwd = [(i, (i + 1) % n) for i in range(n)]
     m, l, acc = F.carry_init(b, h, s, dp)
     qp = _pad_lane(q, d, dp)  # local: pad once, never rotates
+    # tuned per-visit block sizes from the autotune table (keyed on the
+    # LOGICAL head dim; tested default 128x128 on a miss)
+    cblk = F.carry_blocks(b, h, s, d, q.dtype, causal)
 
     def step(diag):
         def run(m, l, acc, k_cur, v_cur):
             return F.flash_carry_step(qp, _pad_lane(k_cur, d, dp),
                                       _pad_lane(v_cur, d, dp), m, l, acc,
-                                      scale=scale, diag=diag)
+                                      scale=scale, diag=diag,
+                                      blk_q=cblk[0], blk_k=cblk[1])
 
         return run
 
@@ -217,6 +244,10 @@ def _ring_flash_bwd_rule(axis, causal, scale, res, g):
     delta = jnp.sum(g.astype(f32) * out.astype(f32), axis=-1)  # (B,H,S)
     kp = _pad_lane(k, d, dp)       # local + stationary: pad once
     vp = _pad_lane(v, d, dp)
+    # per-kernel tuned blocks for the per-visit backward (dq and dkv have
+    # their own autotune entries; tested default 128x128)
+    blk_dq, blk_dkv = F.bwd_blocks(q.shape[0], q.shape[1], q.shape[2], d,
+                                   q.dtype, causal)
 
     def run(diag):
         def go(q_cur, g_cur, lse1_cur, delta_cur):
@@ -224,7 +255,7 @@ def _ring_flash_bwd_rule(axis, causal, scale, res, g):
             dq_s, dk_s, dv_s = F._bwd_call(
                 _pad_lane(q_cur, d, dp), kp, vp,
                 _pad_lane(g_cur, d, dp), lse_b, delta_cur,
-                scale=scale, causal=diag, blk_q=128, blk_k=128,
+                scale=scale, causal=diag, blk_dq=blk_dq, blk_dkv=blk_dkv,
             )
             return (dq_s[..., :d].astype(f32), dk_s[..., :d].astype(f32),
                     dv_s[..., :d].astype(f32))

@@ -98,6 +98,75 @@ def test_single_new_token(params):
     assert out.shape == (2, 5)
 
 
+def test_decode_cache_donation_safety(params):
+    """The donated-cache decode path (donate_cache=True, the default) under
+    the buffer-reuse oracle pattern of tests/test_prefetch.py: every call
+    allocates a FRESH cache and donates it into the compiled program, so a
+    later call reusing the first call's buffers cannot corrupt results —
+    repeated identical calls must be bit-identical, and must match the
+    non-donating build.
+
+    On the CPU test backend donation is gated OFF inside make_generate_fn
+    (jax warns and ignores it there), so here the value-parity half runs
+    on two identical programs; the WIRING is what this test can pin —
+    ``donates_cache`` must reflect the knob x backend — and the aliasing
+    itself is exercised on real hardware (battery ``gpt2_decode``)."""
+    prompt = np.random.RandomState(5).randint(0, CFG.vocab_size,
+                                              (2, 4)).astype(np.int32)
+    gen = make_generate_fn(CFG, max_new_tokens=6, temperature=0.0,
+                           donate_cache=True)
+    assert gen.donates_cache == (jax.default_backend() != "cpu")
+    a = np.asarray(gen(params, prompt, jax.random.PRNGKey(0)))
+    b = np.asarray(gen(params, prompt, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(a, b)
+    no_donate = make_generate_fn(CFG, max_new_tokens=6, temperature=0.0,
+                                 donate_cache=False)
+    assert no_donate.donates_cache is False
+    c = np.asarray(no_donate(params, prompt, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(a, c)
+
+
+def test_decode_unroll_parity(params):
+    """The scan-unroll knob is an execution-shape change only: greedy AND
+    sampled decode produce identical tokens at any unroll (including one
+    that does not divide the step count)."""
+    prompt = np.random.RandomState(6).randint(0, CFG.vocab_size,
+                                              (2, 3)).astype(np.int32)
+    # greedy at unroll 4; sampled (rng threading) at unroll 3, which does
+    # NOT divide the 5-step decode loop — the remainder-handling case
+    for kw, unroll in ((dict(temperature=0.0), 4),
+                       (dict(temperature=0.8, top_k=10), 3)):
+        base = make_generate_fn(CFG, max_new_tokens=6, **kw)
+        want = np.asarray(base(params, prompt, jax.random.PRNGKey(1)))
+        genu = make_generate_fn(CFG, max_new_tokens=6, unroll=unroll, **kw)
+        got = np.asarray(genu(params, prompt, jax.random.PRNGKey(1)))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_decode_hbm_bytes_model(params):
+    """The decode-roofline byte model (bench_generate's denominator) in
+    closed form: non-embedding params once + GATHERED embedding rows (B
+    token rows + 1 position row, not the whole tables) + full KV cache
+    read + one-slot write."""
+    from distributed_tensorflow_guide_tpu.models.generation import (
+        decode_hbm_bytes_per_step,
+    )
+
+    B = 3
+    got = decode_hbm_bytes_per_step(CFG, params, B)
+
+    def nbytes(tree):
+        return sum(leaf.size * np.dtype(leaf.dtype).itemsize
+                   for leaf in jax.tree.leaves(tree))
+
+    tables = nbytes(params["tok_emb"]) + nbytes(params["pos_emb"])
+    gathered = (B + 1) * CFG.d_model * 4  # f32 embedding rows
+    item = np.dtype(CFG.dtype).itemsize
+    kv = CFG.num_layers * 2 * B * CFG.max_len * CFG.num_heads \
+        * (CFG.d_model // CFG.num_heads) * item
+    assert got == nbytes(params) - tables + gathered + kv + kv // CFG.max_len
+
+
 def test_generate_with_dp_sharded_prompts(params):
     """Data-parallel serving: prompts sharded over the data axis produce
     the same tokens as the unsharded run (generate is pure SPMD — the

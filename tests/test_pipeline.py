@@ -197,6 +197,23 @@ def test_pipeline_flop_discipline():
     assert ratio > 0.5, ratio  # sanity floor: blocks can't vanish
 
 
+def test_auto_schedule_resolves_per_mesh():
+    """schedule='auto' picks GPipe on a single-stage mesh (the 1F1B
+    manual-VJP machinery is pure overhead with nothing in flight to cap —
+    round-5 battery: GPipe 99.7k vs 1F1B 87.9k tok/s) and 1F1B at
+    pipe >= 2 (the O(P) activation cap is the point of the schedule)."""
+    mesh1 = build_mesh(MeshSpec(data=-1, pipe=1))
+    pp1 = PipelinedLM(mesh1, CFG, num_microbatches=2, schedule="auto")
+    assert pp1.schedule == "gpipe"
+    mesh2 = build_mesh(MeshSpec(data=-1, pipe=2))
+    pp2 = PipelinedLM(mesh2, CFG, num_microbatches=2, schedule="auto")
+    assert pp2.schedule == "1f1b"
+    # an explicit 1f1b at pipe=1 is honored (with a logged warning), never
+    # silently rewritten
+    pp3 = PipelinedLM(mesh1, CFG, num_microbatches=2, schedule="1f1b")
+    assert pp3.schedule == "1f1b"
+
+
 def test_unknown_schedule_rejected():
     mesh = build_mesh(MeshSpec(data=1, pipe=4, model=2))
     with pytest.raises(ValueError):

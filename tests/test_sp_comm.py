@@ -98,6 +98,43 @@ def test_ring_pallas_fwd_bwd_comm_sites(ctx_mesh):
         rec.bytes["ppermute[context]"], t, thin)
 
 
+def test_ring_pallas_optin_is_never_silent(ctx_mesh, caplog):
+    """Explicitly opting into impl='pallas' must warn once per shape,
+    citing the last measured pallas/xla ratio (round-5 battery), through
+    the package's single degradation registry (fallback_stats) — the
+    opt-in path is allowed to be slow, never silently slow."""
+    import logging
+
+    from distributed_tensorflow_guide_tpu.ops.flash_attention import (
+        fallback_stats,
+    )
+    from distributed_tensorflow_guide_tpu.parallel.sequence import (
+        RING_PALLAS_LAST_MEASURED,
+    )
+
+    d_odd = 48  # unique shape so the once-per-shape warning fires HERE
+    x = jnp.zeros((B, S, H, d_odd), jnp.float32)
+    sm = shard_map(
+        functools.partial(ring_attention, causal=True, impl="pallas"),
+        mesh=ctx_mesh,
+        in_specs=(P(None, "context"),) * 3,
+        out_specs=P(None, "context"),
+        check_vma=False,
+    )
+    key = ("ring_attention_pallas_optin", S // 4, d_odd, 0, 0)
+    before = fallback_stats().get(key, 0)
+    with caplog.at_level(logging.WARNING, logger="dtg.ops.flash"):
+        # the warning fires at TRACE time — eval_shape is enough (no
+        # Mosaic lowering; keeps the tier-1 suite cheap)
+        jax.eval_shape(sm, x, x, x)
+    assert fallback_stats().get(key, 0) == before + 1
+    if before == 0:
+        msgs = [r.message for r in caplog.records]
+        assert any("0.157" in m and "impl='pallas'" in m for m in msgs), msgs
+    # the measured-ratio constant the warning cites stays a real dict
+    assert set(RING_PALLAS_LAST_MEASURED) == {1024, 2048, 4096}
+
+
 def test_ring_auto_selects_measured_winner(ctx_mesh):
     """impl='auto' must select the XLA blockwise path — the on-chip winner
     at every measured length (round-5 battery: Pallas at 0.157–0.487x of
