@@ -99,7 +99,13 @@ def main() -> None:
     # once: the same executable serves the step loop and the peak-memory
     # query (a second jit-triggered compile would double the bench's
     # dominant cost on fake CPU, where the persistent cache is off).
-    step = fsdp.make_train_step(make_lm_loss_fn(model), st_sh)
+    # fused_ce pinned OFF: this bench's metric is per-device MEMORY, and
+    # the fused loss removes the (B, S, V) fp32 logits — letting the
+    # "auto" default flip it on TPU would shift the footprint for a
+    # reason unrelated to FSDP and break comparability with prior
+    # captures (the continuity-pinning rule in run_battery.py)
+    step = fsdp.make_train_step(make_lm_loss_fn(model, fused_ce=False),
+                                st_sh)
     rng = np.random.RandomState(0)
     batch = {
         "tokens": jax.device_put(

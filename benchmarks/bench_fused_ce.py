@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Fused-CE microbench: the LM-head loss alone, naive vs chunked, fwd+bwd.
+
+The round-5 capture left GPT-2 pipeline MFU at 0.36–0.40 with the loss
+path as the dominant HBM term (the (B, S, 50304) fp32 logits + a full
+log_softmax copy per microbatch). This bench isolates exactly that term:
+``value_and_grad`` of the head matmul + cross-entropy at the judged LM
+shape, timed for the naive full-logits path and the chunked fused path
+(``ops/fused_ce.py``), reporting tokens/sec, the speedup, and both sides
+of the closed-form traffic model (``benchmarks/common.loss_bytes_model``)
+so the measured ratio can be compared against the modeled diet.
+
+``--tune`` sweeps the chunk-width candidates on chip and records the
+winner into the autotune table (``ops/autotune.py ensure_ce_tuned``) —
+after which every fused-CE call site in the package picks it up.
+
+Off-TPU this prints an explicit skip line (rc=0) — the traffic ratio only
+means something against real HBM; ``--fake-devices 1 --small`` runs the
+CPU liveness check the smoke suite uses.
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.common import device_setup, loss_bytes_model, report
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    # defaults = the judged gpt2_pp shape's per-step head workload
+    ap.add_argument("--batch", type=int, default=32,
+                    help="sequences per step (gpt2_pp: 4 microbatches x 8)")
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--vocab", type=int, default=50304)
+    ap.add_argument("--dtype", choices=["bfloat16", "float32"],
+                    default="bfloat16",
+                    help="activation dtype (the fused matmuls run in it "
+                         "with f32 accumulation)")
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="vocab chunk width (default: autotune table, "
+                         "else the tested static fallback)")
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--tune", action="store_true",
+                    help="sweep the chunk candidates on chip and record "
+                         "the winner into the autotune table first")
+    ap.add_argument("--small", action="store_true",
+                    help="tiny CPU-liveness geometry")
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="run off-TPU instead of skipping")
+    ap.add_argument("--fake-devices", type=int, default=0)
+    args = ap.parse_args()
+
+    device_setup(args.fake_devices)
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu"
+    if not on_tpu and not (args.fake_devices or args.allow_cpu):
+        # explicit skip, not rc=1: the battery records it as skipped
+        print(json.dumps({
+            "metric": "fused_ce_kernel",
+            "value": None,
+            "unit": "tokens/sec",
+            "vs_baseline": None,
+            "skipped": f"no TPU transport (backend={platform}); the "
+                       "loss-path traffic ratio only means something "
+                       "against real HBM — use --fake-devices 1 --small "
+                       "for the liveness check",
+        }))
+        return
+
+    from distributed_tensorflow_guide_tpu.ops import autotune
+    from distributed_tensorflow_guide_tpu.ops import fused_ce as fce
+
+    b, s, d, v = args.batch, args.seq_len, args.d_model, args.vocab
+    iters = args.iters
+    if args.small:
+        b, s, d, v, iters = 2, 64, 32, 512, min(iters, 3)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    n = b * (s - 1)
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(keys[0], (b, s - 1, d), jnp.float32).astype(dtype)
+    kernel = jax.random.normal(keys[1], (d, v), jnp.float32) * 0.02
+    targets = jax.random.randint(keys[2], (b, s - 1), 0, v, jnp.int32)
+
+    if args.tune and on_tpu:
+        autotune.ensure_ce_tuned(n=n, d=d, v=v, dtype=dtype,
+                                 iters=max(5, iters // 3))
+    chunk = args.chunk or autotune.ce_chunk_for(n=n, d=d, v=v, dtype=dtype)
+
+    def naive_loss(xx, kk):
+        logits = (xx.reshape(n, d).astype(jnp.float32)
+                  @ kk.astype(jnp.float32))
+        logp = jax.nn.log_softmax(logits)
+        ll = jnp.take_along_axis(
+            logp, targets.reshape(n)[:, None], axis=-1)[:, 0]
+        return -jnp.mean(ll)
+
+    def fused_loss(xx, kk):
+        return fce.fused_cross_entropy(
+            xx.reshape(n, d), kk, targets.reshape(n), chunk=chunk)
+
+    runs = {}
+    for name, loss in (("naive", naive_loss), ("fused", fused_loss)):
+        f = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))
+        runs[name] = autotune.measure_runner(
+            lambda f=f: f(x, kernel), iters=iters)
+
+    head_naive = loss_bytes_model(b, s, v, d)
+    head_fused = loss_bytes_model(b, s, v, d, chunk=chunk)
+    report("fused_ce_kernel", n / runs["fused"], "tokens/sec",
+           naive_tokens_per_sec=round(n / runs["naive"], 1),
+           speedup_vs_naive=round(runs["naive"] / runs["fused"], 3),
+           chunk=chunk, batch=b, seq_len=s, d_model=d, vocab=v,
+           dtype=args.dtype,
+           secs_per_call=round(runs["fused"], 6),
+           naive_secs_per_call=round(runs["naive"], 6),
+           head_hbm_gb=round(head_fused / 1e9, 3),
+           head_hbm_gb_naive=round(head_naive / 1e9, 3),
+           tuned=bool(args.tune and on_tpu))
+
+
+if __name__ == "__main__":
+    main()

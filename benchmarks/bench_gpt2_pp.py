@@ -15,6 +15,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 from benchmarks.common import (
     device_setup,
     lm_model_flops_per_step,
+    loss_bytes_model,
     mfu_extras,
     report,
     time_steps,
@@ -63,6 +64,23 @@ def main() -> None:
                          "fewer hardware FLOPs when the microbatch "
                          "activations fit in HBM (they do at seq 512, "
                          "microbatch 8, 1 chip); echoed in the JSON line")
+    ap.add_argument("--fused-ce", choices=["auto", "on", "off"],
+                    default="auto",
+                    help="chunked fused cross-entropy (ops/fused_ce.py): "
+                         "head matmul + online LSE + grad-of-logits per "
+                         "vocab chunk, no (B, S, V) fp32 logits live in "
+                         "fwd or bwd — the round-8 HBM diet. The battery "
+                         "pins on|off on both sides of the A/B (row "
+                         "gpt2_pp_fused_ce vs gpt2_pp_gpipe) so the "
+                         "resolved setting — echoed in the JSON — is the "
+                         "only changed variable")
+    ap.add_argument("--precision", default=None,
+                    choices=["f32", "bf16", "bf16_remat",
+                             "bf16_remat_attn"],
+                    help="mixed-precision policy (core/precision.py) "
+                         "overriding this bench's per-config dtypes; "
+                         "bf16_remat_attn = checkpoint attention only. "
+                         "Echoed in the JSON when set")
     ap.add_argument("--steps-per-call", type=int, default=1,
                     help="optimizer steps per compiled dispatch (lax.scan "
                          "inside the program; amortizes tunnel launch "
@@ -108,7 +126,10 @@ def main() -> None:
     try:
         pp = PipelinedLM(mesh, cfg, num_microbatches=args.microbatches,
                          schedule=args.schedule,
-                         virtual_chunks=args.virtual_chunks)
+                         virtual_chunks=args.virtual_chunks,
+                         fused_ce=args.fused_ce,
+                         precision=args.precision)
+        cfg = pp.cfg  # a --precision policy may have rewritten dtype/remat
     except ValueError as e:
         if "pipe >= 2" not in str(e):
             raise
@@ -146,8 +167,34 @@ def main() -> None:
     dt, _ = time_steps(step2, (opt_state, params), tokens, steps=args.steps)
 
     opt_steps = args.steps * args.steps_per_call
-    # pp.schedule is the RESOLVED schedule (--schedule auto picks per mesh)
-    extra = {"schedule": pp.schedule}
+    # pp.schedule / pp.fused_ce are the RESOLVED settings ("auto" picks per
+    # mesh / per platform+vocab); head_hbm_gb is the closed-form LM-head
+    # loss traffic of the path in use (benchmarks/common.loss_bytes_model —
+    # the PR-2 decode_hbm_bytes_per_step pattern), with the naive figure
+    # alongside so the diet ratio is visible in the JSON itself.
+    from distributed_tensorflow_guide_tpu.ops.autotune import ce_chunk_for
+
+    # chunk echoed with EXACTLY the key the compiled step resolves:
+    # _mb_loss_fused sees one microbatch of hidden states and this
+    # device's vocab shard, so the table key is (n = mb·(S−1), v = V/tp) —
+    # keying on the global batch / full vocab here would echo a chunk the
+    # step never uses whenever tp > 1 or the tuner recorded per-shard
+    chunk = (ce_chunk_for(n=args.microbatch_size * (cfg.max_len - 1),
+                          d=cfg.d_model,
+                          v=cfg.vocab_size // sizes["model"],
+                          dtype=cfg.dtype)
+             if pp.fused_ce else None)
+    head_naive = loss_bytes_model(global_batch, cfg.max_len, cfg.vocab_size,
+                                  cfg.d_model)
+    head_used = loss_bytes_model(global_batch, cfg.max_len, cfg.vocab_size,
+                                 cfg.d_model, chunk=chunk)
+    extra = {"schedule": pp.schedule, "fused_ce": pp.fused_ce,
+             "head_hbm_gb": round(head_used / 1e9, 3),
+             "head_hbm_gb_naive": round(head_naive / 1e9, 3)}
+    if pp.fused_ce:
+        extra["ce_chunk"] = chunk
+    if args.precision:
+        extra["precision"] = args.precision
     if args.steps_per_call > 1:
         extra["steps_per_call"] = args.steps_per_call
     if args.no_remat:

@@ -36,6 +36,12 @@ def main() -> None:
     ap.add_argument("--seq-len", type=int, default=512)
     ap.add_argument("--global-batch", type=int, default=8)
     ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--fused-ce", choices=["auto", "on", "off"],
+                    default="auto",
+                    help="chunked fused cross-entropy for the LM head "
+                         "(ops/fused_ce.py); the battery continuity row "
+                         "pins off so fused CE never flips a number of "
+                         "record silently (resolved setting echoed)")
     args = ap.parse_args()
 
     device_setup(args.fake_devices)
@@ -60,7 +66,8 @@ def main() -> None:
         d_model=args.d_model, d_ff=args.d_ff, max_len=args.seq_len,
         causal=True, dtype=dtype,
     )
-    lm = SwitchLM(mesh, cfg, args.num_experts, top_k=args.top_k)
+    lm = SwitchLM(mesh, cfg, args.num_experts, top_k=args.top_k,
+                  fused_ce=args.fused_ce)
     params = lm.init_params(jax.random.PRNGKey(0))
     tx = optax.adam(1e-4)
     opt_state = lm.init_opt_state(tx, params)
@@ -83,7 +90,8 @@ def main() -> None:
 
     dt, _ = time_steps(step, state, tokens, warmup=3, steps=args.steps)
     toks = args.global_batch * args.seq_len * args.steps
-    report("switch_moe_lm_throughput", toks / dt, "tokens/sec")
+    report("switch_moe_lm_throughput", toks / dt, "tokens/sec",
+           fused_ce=lm.fused_ce)
 
 
 if __name__ == "__main__":

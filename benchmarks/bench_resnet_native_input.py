@@ -50,6 +50,11 @@ def main() -> None:
                          "(S+32, S+32), random-crop to (S, S) + hflip in "
                          "the C++ gather copy — the augmented input-path "
                          "contract, not a memcpy")
+    ap.add_argument("--small-model", action="store_true",
+                    help="ResNet18ish instead of the judged ResNet-50: the "
+                         "loader/augment/prefetch contract under test is "
+                         "model-independent, and the CPU smoke was paying "
+                         "a 50-layer compile for it (echoed in the JSON)")
     args = ap.parse_args()
 
     device_setup(args.fake_devices)
@@ -69,6 +74,7 @@ def main() -> None:
         write_records,
     )
     from distributed_tensorflow_guide_tpu.models.resnet import (
+        ResNet18ish,
         ResNet50,
         make_loss_fn,
     )
@@ -115,7 +121,8 @@ def main() -> None:
         done += n
 
     # 2. judged ResNet-50 step; uint8 -> float normalization INSIDE jit
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    model_cls = ResNet18ish if args.small_model else ResNet50
+    model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
     variables = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3)), train=False
     )
@@ -209,6 +216,7 @@ def main() -> None:
         record_kib=round(rec_bytes / 1024, 1),
         loader_mb_per_sec=round(loader_only * rec_bytes / 2**20, 1),
         augmented=bool(augment),
+        small_model=bool(args.small_model),
         **prefetch_stats,
     )
 

@@ -326,14 +326,20 @@ def lm_model_flops_per_step(cfg, global_batch: int) -> float:
     # tp_axis=None strips the manual f/g collectives from the trace;
     # override_head_dim stays — a tp_local per-shard config must count its
     # true per-shard shapes (callers then scale by n_devices in mfu_extras).
+    # remat cleared at BOTH spellings (legacy bool + precision-policy
+    # remat_mode): recompute is scheduled overhead, not model work.
     flop_cfg = dataclasses.replace(
-        cfg, attn_impl="dense", remat=False, tp_axis=None)
+        cfg, attn_impl="dense", remat=False, remat_mode=None, tp_axis=None)
     model = Transformer(flop_cfg)
     tokens = jax.ShapeDtypeStruct((global_batch, flop_cfg.max_len), jnp.int32)
     params = jax.eval_shape(
         model.init, jax.random.PRNGKey(0), tokens)["params"]
     if flop_cfg.num_classes is None:
-        loss_fn = make_lm_loss_fn(model)
+        # fused_ce pinned off: the MFU numerator is the LOGICAL model (the
+        # chunked loop does the same matmul work, but the convention traces
+        # the naive head so the numerator can never move with a loss-path
+        # A/B knob)
+        loss_fn = make_lm_loss_fn(model, fused_ce=False)
         batch = {"tokens": tokens}
     else:
         loss_fn = make_cls_loss_fn(model)
@@ -351,6 +357,39 @@ def model_flops_per_step(loss_fn, *abstract_args) -> float:
     )
 
     return 3.0 * traced_matmul_flops(loss_fn, *abstract_args)
+
+
+def loss_bytes_model(batch: int, seq: int, vocab: int, d_model: int, *,
+                     chunk: int | None = None, act_bytes: int = 2,
+                     param_bytes: int = 4) -> float:
+    """Closed-form HBM traffic (bytes) of ONE training step's LM-head loss
+    — the naive-vs-chunked model behind the fused-CE diet, mirroring
+    ``models/generation.py decode_hbm_bytes_per_step``.
+
+    N = batch·(seq−1) next-token positions; head intermediates are f32.
+
+    * ``chunk=None`` (naive): the (N, V) logits round-trip HBM ~7 times —
+      matmul out write, log_softmax read + logp write, backward logp read +
+      dz write, dz read by each of the two grad matmuls — plus the common
+      terms (x read fwd, W read fwd + bwd-dx matmul, dx/dW writes).
+    * chunked (fused CE): the (N, chunk) score tile is assumed VMEM-
+      resident (the tuner's candidate filter targets exactly that), so the
+      full-logit passes VANISH; what remains is the common terms plus one
+      extra read each of x and W for the backward recompute.
+
+    Like every roofline model here this is MINIMAL algorithmic traffic —
+    spills push the measured fraction down, which is the tuning signal.
+    """
+    n = batch * (seq - 1)
+    x_bytes = n * d_model * act_bytes
+    w_bytes = d_model * vocab * param_bytes
+    dw_bytes = d_model * vocab * 4  # f32 grad
+    common = 2 * w_bytes + x_bytes + x_bytes + dw_bytes  # W fwd+bwd, x, dx out
+    if chunk is None or chunk >= vocab:
+        return common + 7.0 * n * vocab * 4
+    # fused: +1 x read and +1 W read for the bwd recompute; per-chunk f32
+    # tiles stay on chip
+    return common + x_bytes + w_bytes
 
 
 def mfu_extras(model_flops_per_step: float, steps: int, dt: float,

@@ -55,7 +55,11 @@ def try_case(case: str, seq: int, remat: bool, layers: int,
         0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     params = jax.jit(model.init)(jax.random.PRNGKey(0), tokens[:1])["params"]
 
-    loss_fn = make_lm_loss_fn(model)
+    # fused_ce=False: this repro must keep building the HISTORICAL failing
+    # program (full-vocab logits head) — the fused-CE auto default would
+    # silently rewrite the "head on" bisection axis on TPU, the one
+    # platform the repro targets.
+    loss_fn = make_lm_loss_fn(model, fused_ce=False)
     if case == "fwd":
         fn = jax.jit(lambda p, t: loss_fn(p, {"tokens": t})[0])
     else:  # fwd+bwd — the training path that failed

@@ -26,6 +26,7 @@ BENCHES = [
     "bench_sp_comm.py",       # SP layouts: ring vs Ulysses ICI traffic
     "bench_generate.py",      # serving: KV-cache decode tokens/sec
     "bench_flash_kernel.py",  # kernel-only flash/carry roofline fractions
+    "bench_fused_ce.py",      # LM-head loss alone: naive vs chunked fused CE
 ]
 
 # Tiny fake-device configs, small enough for CPU (also used by
@@ -41,11 +42,14 @@ SMOKE = {
         ["--fake-devices", "8", "--global-batch", "64", "--steps", "3"],
     "bench_gpt2_pp.py":
         # the full 3D smoke: dp x tp x pp with the combined interleaved-
-        # 1F1B schedule — the production composition, exercised end-to-end
+        # 1F1B schedule — the production composition, exercised end-to-end.
+        # --fused-ce on: the smoke is what exercises the fused vocab-
+        # parallel CE through the whole pipeline ("auto" resolves off on
+        # the fake-CPU mesh)
         ["--fake-devices", "8", "--pipe", "2", "--model-parallel", "2",
          "--schedule", "1f1b", "--virtual-chunks", "2", "--small",
          "--microbatches", "2", "--microbatch-size", "1",
-         "--seq-len", "64", "--steps", "2"],
+         "--seq-len", "64", "--steps", "2", "--fused-ce", "on"],
     "bench_native_input.py":
         ["--fake-devices", "8", "--global-batch", "64", "--records", "512",
          "--steps", "5"],
@@ -68,9 +72,13 @@ SMOKE = {
          "--heads", "8", "--head-dim", "16"],
     "bench_resnet_native_input.py":
         # --augment: crop+flip in the C++ gather copy — the input-path
-        # contract the judged ResNet config trains under (round-5)
-        ["--fake-devices", "4", "--global-batch", "16", "--records", "128",
-         "--steps", "3", "--image-size", "64", "--augment"],
+        # contract the judged ResNet config trains under (round-5).
+        # --small-model + 32px: the contract is model-independent and the
+        # smoke was spending ~70s compiling ResNet-50 on CPU (round-8
+        # tier-1 wall-clock budget)
+        ["--fake-devices", "4", "--global-batch", "16", "--records", "64",
+         "--steps", "2", "--image-size", "32", "--augment",
+         "--small-model"],
     "bench_generate.py":
         ["--fake-devices", "1", "--small", "--batch", "2",
          "--prompt-len", "16", "--max-new", "8", "--iters", "2",
@@ -78,6 +86,11 @@ SMOKE = {
     "bench_flash_kernel.py":
         # interpret-mode liveness: every kernel (fwd/dq/dkv/carry) runs end
         # to end and emits its roofline-model keys; timings meaningless
+        ["--fake-devices", "1", "--small"],
+    "bench_fused_ce.py":
+        # CPU liveness: naive + fused fwd/bwd run end to end and emit the
+        # closed-form traffic keys; timings meaningless (off-TPU skip-JSON
+        # contract covers the no-flag real-mode path)
         ["--fake-devices", "1", "--small"],
 }
 

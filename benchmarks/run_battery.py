@@ -31,22 +31,40 @@ ROOT = Path(__file__).resolve().parents[1]
 # (name, argv, timeout_s) — argv relative to repo root.
 BATTERY: list[tuple[str, list[str], int]] = [
     ("resnet_flagship", ["bench.py"], 2400),
+    # fused BN+ReLU A/B vs the flagship row above (round 8): the ONLY
+    # changed variable is the BN path — same batch, same sustained mode
+    ("resnet_fused_bn", ["bench.py", "--fused-bn"], 2400),
     # bench_gpt2_pp's default schedule is now "auto" (GPipe at pipe=1, the
     # measured record config); the 1F1B rows pin it explicitly so the A/B
-    # stays an A/B
+    # stays an A/B. Round 8: every continuity row ALSO pins --fused-ce off
+    # — fused_ce="auto" resolves ON for TPU + GPT-2 vocab, and letting it
+    # flip would change two variables at once (the round-7 schedule-pinning
+    # lesson); the dedicated fused_ce rows below carry the A/B.
     ("gpt2_pp_1f1b",
-     ["benchmarks/bench_gpt2_pp.py", "--schedule", "1f1b"], 1800),
+     ["benchmarks/bench_gpt2_pp.py", "--schedule", "1f1b",
+      "--fused-ce", "off"], 1800),
     ("gpt2_pp_interleaved_1f1b",
      ["benchmarks/bench_gpt2_pp.py", "--schedule", "1f1b",
-      "--virtual-chunks", "2"], 1800),
+      "--virtual-chunks", "2", "--fused-ce", "off"], 1800),
     ("gpt2_pp_gpipe",
-     ["benchmarks/bench_gpt2_pp.py", "--schedule", "gpipe"], 1800),
+     ["benchmarks/bench_gpt2_pp.py", "--schedule", "gpipe",
+      "--fused-ce", "off"], 1800),
+    # fused-CE chunk sweep FIRST (records the winning chunk into the
+    # autotune table), then the pipeline A/B row: identical argv to
+    # gpt2_pp_gpipe except --fused-ce on — fused CE is the only changed
+    # variable vs that row. The pair adjudicates the round-8 MFU>=0.45
+    # target (BASELINE.md config 5).
+    ("fused_ce_kernel",
+     ["benchmarks/bench_fused_ce.py", "--tune"], 1200),
+    ("gpt2_pp_fused_ce",
+     ["benchmarks/bench_gpt2_pp.py", "--schedule", "gpipe",
+      "--fused-ce", "on"], 1800),
     ("gpt2_pp_1f1b_spc8",
      ["benchmarks/bench_gpt2_pp.py", "--schedule", "1f1b",
-      "--steps-per-call", "8", "--steps", "8"], 1800),
+      "--steps-per-call", "8", "--steps", "8", "--fused-ce", "off"], 1800),
     ("gpt2_pp_1f1b_noremat",
      ["benchmarks/bench_gpt2_pp.py", "--schedule", "1f1b",
-      "--no-remat"], 1800),
+      "--no-remat", "--fused-ce", "off"], 1800),
     # kernel-only roofline + autotune FIRST: --tune records the winning
     # blocks into the persistent table; --tune-seqs covers every seq the
     # rows below key on (the table matches s exactly: 1024/2048 for the
@@ -63,10 +81,12 @@ BATTERY: list[tuple[str, list[str], int]] = [
     # the schedule would change two variables at once
     ("gpt2_flash_seq1024",
      ["benchmarks/bench_gpt2_pp.py", "--schedule", "1f1b",
-      "--seq-len", "1024", "--microbatch-size", "1"], 1800),
+      "--seq-len", "1024", "--microbatch-size", "1",
+      "--fused-ce", "off"], 1800),
     ("gpt2_flash_seq2048",
      ["benchmarks/bench_gpt2_pp.py", "--schedule", "1f1b",
-      "--seq-len", "2048", "--microbatch-size", "1"], 1800),
+      "--seq-len", "2048", "--microbatch-size", "1",
+      "--fused-ce", "off"], 1800),
     ("bert_tp", ["benchmarks/bench_bert_tp.py"], 1800),
     ("gpt2_decode", ["benchmarks/bench_generate.py"], 1800),
     # decode-roofline A/B: scan unroll (the donation default is already on)
@@ -88,7 +108,9 @@ BATTERY: list[tuple[str, list[str], int]] = [
       "--cases", "grad"], 2400),
     ("mnist_dp", ["benchmarks/bench_mnist_dp.py"], 1200),
     ("wide_deep", ["benchmarks/bench_wide_deep.py"], 1200),
-    ("moe_lm", ["benchmarks/bench_moe_lm.py"], 1800),
+    # continuity pin, same rule as the gpt2_pp rows: SwitchLM's
+    # fused_ce="auto" would otherwise flip this row's loss path on TPU
+    ("moe_lm", ["benchmarks/bench_moe_lm.py", "--fused-ce", "off"], 1800),
     ("native_input", ["benchmarks/bench_native_input.py"], 1200),
     ("resnet_native_input",
      ["benchmarks/bench_resnet_native_input.py"], 1800),

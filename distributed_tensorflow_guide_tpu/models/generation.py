@@ -42,8 +42,11 @@ def decode_config(cfg: TransformerConfig) -> TransformerConfig:
     """The serving view of a training config: KV-cache attention (dense —
     flash is a long-context *training* kernel; decode chunks are 1 token),
     no remat (nothing to rematerialize without a backward pass)."""
+    # remat cleared at BOTH spellings: the precision-policy remat_mode wins
+    # over the legacy bool in resolved_remat_mode, so leaving it set would
+    # silently keep checkpointing in the serving forward
     return dataclasses.replace(cfg, decode=True, attn_impl="dense",
-                               remat=False)
+                               remat=False, remat_mode=None)
 
 
 def cache_shapes(cfg: TransformerConfig, batch_size: int):

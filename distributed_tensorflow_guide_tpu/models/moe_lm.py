@@ -88,7 +88,15 @@ class SwitchLM:
 
     def __init__(self, mesh: Mesh, cfg: TransformerConfig,
                  num_experts: int, *, top_k: int = 1,
-                 capacity_factor: float = 2.0, aux_weight: float = 1e-2):
+                 capacity_factor: float = 2.0, aux_weight: float = 1e-2,
+                 fused_ce="auto", ce_chunk: int | None = None,
+                 precision=None):
+        if precision is not None:
+            from distributed_tensorflow_guide_tpu.core import (
+                precision as precision_mod,
+            )
+
+            cfg = precision_mod.resolve(precision).apply_to_transformer(cfg)
         sizes = axis_sizes(mesh)
         if num_experts % sizes["expert"]:
             raise ValueError(
@@ -109,6 +117,18 @@ class SwitchLM:
         self.attn_block = _AttnBlock(cfg)
         self.ln2 = nn.LayerNorm(dtype=cfg.dtype)
         self.head = _Head(cfg)
+        # chunked fused CE (ops/fused_ce.py): loss + grad-of-logits per
+        # vocab chunk, no (B, S, V) logits live — same knob/resolution as
+        # PipelinedLM; the raw LN applies ln_f with explicit params on the
+        # fused path (the _Head module would materialize full logits)
+        from distributed_tensorflow_guide_tpu.ops.fused_ce import (
+            resolve_fused_ce,
+        )
+
+        self.fused_ce = resolve_fused_ce(fused_ce,
+                                         vocab_size=cfg.vocab_size)
+        self.ce_chunk = ce_chunk
+        self._head_ln = nn.LayerNorm(dtype=cfg.dtype)
 
     # -- params ---------------------------------------------------------------
     def init_params(self, rng) -> dict:
@@ -154,8 +174,10 @@ class SwitchLM:
         )
 
     # -- forward --------------------------------------------------------------
-    def _forward(self, params, tokens):
-        """Per-device forward: tokens (B_local, S) -> (logits, aux)."""
+    def _forward(self, params, tokens, *, return_hidden: bool = False):
+        """Per-device forward: tokens (B_local, S) -> (logits, aux) — or
+        (pre-head hidden states, aux) with ``return_hidden`` (the fused-CE
+        entry point, which must never see full-vocab logits)."""
         cfg = self.cfg
         x = self.embedder.apply({"params": params["embed"]}, tokens)
         b, s, d = x.shape
@@ -170,19 +192,38 @@ class SwitchLM:
             layer, x, {"attn": params["attn"], "ln2": params["ln2"],
                        "moe": params["moe"]}
         )
-        logits = self.head.apply({"params": params["head"]}, x)
         aux = jax.tree.map(jnp.mean, auxs)  # mean over layers
+        if return_hidden:
+            return x, aux
+        logits = self.head.apply({"params": params["head"]}, x)
         return logits, aux
 
     def _local_loss(self, params, tokens):
-        """Global-mean LM loss + aux, computed from this device's shard."""
-        logits, aux = self._forward(params, tokens)
-        logp = jax.nn.log_softmax(logits[:, :-1])
-        ll = jnp.take_along_axis(
-            logp, tokens[:, 1:][..., None], axis=-1
-        )[..., 0]
-        se = -jnp.sum(ll)
-        n = jnp.array(ll.size, jnp.float32)
+        """Global-mean LM loss + aux, computed from this device's shard.
+
+        Both paths produce the identical (sum-of-NLL, count) pair so the
+        global mean stays the same psum/psum assembly; the fused path just
+        never materializes the (B, S, V) logits it sums over.
+        """
+        n = jnp.array(tokens.shape[0] * (tokens.shape[1] - 1), jnp.float32)
+        if self.fused_ce:
+            from distributed_tensorflow_guide_tpu.ops.fused_ce import (
+                fused_next_token_loss,
+            )
+
+            x, aux = self._forward(params, tokens, return_hidden=True)
+            xh = self._head_ln.apply(
+                {"params": params["head"]["ln_f"]}, x)
+            se = fused_next_token_loss(
+                xh, params["head"]["lm_head"]["kernel"], tokens,
+                chunk=self.ce_chunk, reduction="sum")
+        else:
+            logits, aux = self._forward(params, tokens)
+            logp = jax.nn.log_softmax(logits[:, :-1])
+            ll = jnp.take_along_axis(
+                logp, tokens[:, 1:][..., None], axis=-1
+            )[..., 0]
+            se = -jnp.sum(ll)
         axes = self.moe_cfg.token_axes
         lm = cc.psum(se, axes) / cc.psum(n, axes)
         loss = lm + self.aux_weight * (aux["load_balance"] + aux["z_loss"])

@@ -51,6 +51,12 @@ class TransformerConfig:
     causal: bool = True
     dtype: Dtype = jnp.bfloat16
     remat: bool = False
+    # Selective rematerialization (core/precision.py): None derives from the
+    # legacy ``remat`` bool ("block" when True); "attention" checkpoints ONLY
+    # the attention sub-layer per block — recompute the high-traffic part,
+    # keep the MLP activations resident; "block" is the classic full-block
+    # checkpoint (what remat=True always meant); "none" stores everything.
+    remat_mode: str | None = None
     num_classes: int | None = None  # set → classification head (BERT/GLUE)
     # "dense"  — XLA softmax attention (materializes (S, S) scores). GSPMD
     #            partitions it under pjit, so it composes with TP sharding.
@@ -89,6 +95,19 @@ class TransformerConfig:
                 "attn_impl must be 'auto', 'dense' or 'flash', "
                 f"got {self.attn_impl!r}"
             )
+        if self.remat_mode not in (None, "none", "attention", "block"):
+            raise ValueError(
+                "remat_mode must be None, 'none', 'attention' or 'block', "
+                f"got {self.remat_mode!r}"
+            )
+
+    @property
+    def resolved_remat_mode(self) -> str:
+        """The effective remat mode: explicit ``remat_mode`` wins, else the
+        legacy bool maps True -> "block"."""
+        if self.remat_mode is not None:
+            return self.remat_mode
+        return "block" if self.remat else "none"
 
     def resolve_attn_impl(self, seq_len: int | None = None) -> str:
         """Resolve 'auto' against the actual (trace-time) sequence length;
@@ -294,7 +313,16 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, index=None) -> jax.Array:
         cfg = self.cfg
-        x = x + MultiHeadAttention(cfg, name="attn")(
+        # Attention-only selective remat (core/precision.py): checkpoint the
+        # attention sub-layer here so EVERY consumer — the flat Transformer,
+        # all four pipeline schedules — gets the same HBM/FLOP trade without
+        # per-schedule wiring. nn.remat preserves the "attn" param path, so
+        # the layout is identical across modes. prevent_cse=False as in the
+        # block-level sites (scan bodies need no CSE barrier).
+        attn_cls = MultiHeadAttention
+        if cfg.resolved_remat_mode == "attention":
+            attn_cls = nn.remat(MultiHeadAttention, prevent_cse=False)
+        x = x + attn_cls(cfg, name="attn")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x), index
         )
         x = x + MLP(cfg, name="mlp")(
@@ -310,10 +338,16 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array, index=None) -> jax.Array:
+    def __call__(self, tokens: jax.Array, index=None, *,
+                 return_hidden: bool = False) -> jax.Array:
         # tokens (B, S) int32; ``index`` only in cfg.decode mode: the
         # absolute position of tokens[:, 0] (prefill passes 0, the decode
-        # loop passes the running length)
+        # loop passes the running length). ``return_hidden`` stops after the
+        # final LayerNorm and returns the (B, S, D) hidden states WITHOUT
+        # applying the LM head — the entry point of the fused
+        # cross-entropy loss path (ops/fused_ce.py), which must never see
+        # full-vocab logits. Param layout is unchanged (init runs the
+        # default call, so lm_head still materializes).
         cfg = self.cfg
         if cfg.decode and index is None:
             raise ValueError("cfg.decode=True requires the position index")
@@ -338,11 +372,13 @@ class Transformer(nn.Module):
         x = _constrain(x, ("batch", "seq", "embed"))
 
         block = Block
-        if cfg.remat:
+        if cfg.resolved_remat_mode == "block":
             block = nn.remat(Block, prevent_cse=False)
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"block_{i}")(x, index)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        if return_hidden:
+            return x
 
         if cfg.num_classes is not None:
             cls = x[:, 0]  # [CLS] pooling
@@ -359,11 +395,35 @@ class Transformer(nn.Module):
         return logits
 
 
-def make_lm_loss_fn(model: Transformer):
-    """Next-token LM loss: ``(params, batch{tokens}) -> (loss, metrics)``."""
+def make_lm_loss_fn(model: Transformer, *, fused_ce="auto",
+                    ce_chunk: int | None = None):
+    """Next-token LM loss: ``(params, batch{tokens}) -> (loss, metrics)``.
+
+    ``fused_ce`` ("auto"|True|False, resolved by
+    ``ops.fused_ce.resolve_fused_ce``) routes the head through the chunked
+    fused cross-entropy: the trunk stops at the final LayerNorm
+    (``return_hidden``) and loss + grad-of-logits run per vocab chunk, so
+    no ``(B, S, V)`` tensor is ever live — the HBM diet for every DP/FSDP
+    LM call site. The naive path is byte-identical to the historical one.
+    """
+    from distributed_tensorflow_guide_tpu.ops.fused_ce import (
+        fused_next_token_loss,
+        resolve_fused_ce,
+    )
+
+    use_fused = resolve_fused_ce(fused_ce, vocab_size=model.cfg.vocab_size)
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
+        if use_fused:
+            hidden = model.apply({"params": params}, tokens,
+                                 return_hidden=True)
+            # params may carry flax partitioning boxes (logical-axis
+            # metadata); the kernel itself is the boxed value
+            kernel = nn.meta.unbox(params["lm_head"]["kernel"])
+            loss = fused_next_token_loss(hidden, kernel, tokens,
+                                         chunk=ce_chunk)
+            return loss, {"perplexity": jnp.exp(loss)}
         logits = model.apply({"params": params}, tokens)  # (B, S, V)
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits[:, :-1])

@@ -41,6 +41,23 @@ KERNELS = ("flash_fwd", "flash_dq", "flash_dkv", "carry_step")
 # hardcode, now the one definition it reduces to.
 DEFAULT_BLOCKS: tuple[int, int] = (128, 128)
 
+# --- chunked fused cross-entropy (ops/fused_ce.py) -------------------------
+# Same table, same platform keying, same CPU defaults-only contract — but a
+# ONE-dimensional tuning axis: the vocab-chunk width of the fused CE loop.
+# The key reuses _key with (b=N tokens, h=0, s=V_local, d=d_model); entries
+# store {"chunk": c}.
+CE_KERNEL = "fused_ce"
+
+# Tested static fallback: at GPT-2's (N=16k, V=50304) shape an 8k-wide f32
+# score tile is (N, 8192) per chunk — comfortably inside the per-core VMEM
+# working set for the microbatch sizes the pipeline feeds the head, and
+# seven chunks keep the python-unrolled loop's trace cost trivial.
+DEFAULT_CE_CHUNK = 8192
+
+# Sweep grid for --tune (bench_fused_ce.py): lane-multiple widths from one
+# MXU tile column block up to half the GPT-2 vocab.
+CE_CHUNK_CANDIDATES = (1024, 2048, 4096, 8192, 16384, 32768)
+
 LANE = 128  # TPU lane width; block edges must be sublane (8) multiples
 
 # Block-edge candidates for the sweep, filtered per shape by divisibility
@@ -202,11 +219,140 @@ def record(kernel: str, *, b: int, h: int, s: int, d: int, dtype,
         _mem[_key(kernel, b, h, s, d, dt, causal, plat)] = ent
         if generalize:
             _mem[_key(kernel, 0, 0, s, d, dt, causal, plat)] = dict(ent)
-        path = table_path()
-        path.parent.mkdir(parents=True, exist_ok=True)
-        tmp = path.with_name(path.name + ".tmp")
-        tmp.write_text(json.dumps(_mem, indent=1, sort_keys=True))
-        os.replace(tmp, path)
+        _persist_locked()
+
+
+def _persist_locked() -> None:
+    """Write the in-memory table to disk (caller holds ``_lock``)."""
+    path = table_path()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(_mem, indent=1, sort_keys=True))
+    os.replace(tmp, path)
+
+
+# --------------------------------------------------------------------------
+# fused cross-entropy chunk table (ops/fused_ce.py call sites)
+# --------------------------------------------------------------------------
+
+
+def ce_chunk_candidates(v: int) -> list[int]:
+    """The sweep grid for one vocab width: candidate chunks that actually
+    chunk (strictly narrower than the vocab — at chunk >= V the fused loop
+    degenerates to the single-matmul pass the default already covers)."""
+    return [c for c in CE_CHUNK_CANDIDATES if c < v]
+
+
+def ce_chunk_lookup(*, n: int, d: int, v: int, dtype,
+                    platform: str | None = None) -> int | None:
+    """Tuned chunk for the key, or None. Exact-N entry first, then the
+    N-generic one the sweep also records; entries wider than the vocab are
+    clipped (stale-table safety)."""
+    plat = _platform(platform)
+    _maybe_load(plat)
+    dt = _dtype_name(dtype)
+    for key in (_key(CE_KERNEL, n, 0, v, d, dt, False, plat),
+                _key(CE_KERNEL, 0, 0, v, d, dt, False, plat)):
+        ent = _mem.get(key)
+        if ent and int(ent.get("chunk", 0)) > 0:
+            return min(int(ent["chunk"]), v)
+    return None
+
+
+def ce_chunk_for(*, n: int, d: int, v: int, dtype,
+                 platform: str | None = None) -> int:
+    """The chunk a fused-CE call site should use: the tuned entry when one
+    exists, else ``DEFAULT_CE_CHUNK`` (clipped to the vocab). Never sweeps,
+    never writes — safe at trace time on any platform; on CPU the table is
+    never even read (``_maybe_load`` hermeticity contract)."""
+    hit = ce_chunk_lookup(n=n, d=d, v=v, dtype=dtype, platform=platform)
+    return hit if hit is not None else min(DEFAULT_CE_CHUNK, v)
+
+
+def ce_record(*, n: int, d: int, v: int, dtype, chunk: int,
+              detail: dict | None = None, platform: str | None = None,
+              generalize: bool = True) -> None:
+    """Write one fused-CE chunk entry (exact-N key + the N-generic key) and
+    persist. Refused on CPU — same defaults-only contract as :func:`record`."""
+    plat = _platform(platform)
+    if plat == "cpu":
+        raise RuntimeError(
+            "autotune.ce_record refused on the CPU platform: tier-1 CI is a "
+            "defaults-only path (no table writes, no sweeps) so its traced "
+            "programs never depend on ambient tuning state")
+    chunk = int(chunk)
+    if chunk < 1 or chunk > v:
+        raise ValueError(f"chunk {chunk} invalid for vocab {v}")
+    _maybe_load(plat)
+    dt = _dtype_name(dtype)
+    ent: dict = {"chunk": chunk}
+    if detail:
+        ent["detail"] = detail
+    with _lock:
+        _mem[_key(CE_KERNEL, n, 0, v, d, dt, False, plat)] = ent
+        if generalize:
+            _mem[_key(CE_KERNEL, 0, 0, v, d, dt, False, plat)] = dict(ent)
+        _persist_locked()
+
+
+def ensure_ce_tuned(*, n: int, d: int, v: int, dtype, iters: int = 10,
+                    measure: Callable | None = None,
+                    platform: str | None = None) -> int:
+    """Tuned fused-CE chunk for the key — from the table when present (no
+    re-sweep), else sweep-and-record. ``measure(chunk) -> secs_per_call``
+    is injectable for tests; the default times the real fused loss
+    (value_and_grad — the chunk choice is a BACKWARD-traffic property too).
+    Refused on CPU."""
+    hit = ce_chunk_lookup(n=n, d=d, v=v, dtype=dtype, platform=platform)
+    if hit is not None:
+        return hit
+    plat = _platform(platform)
+    if plat == "cpu":
+        raise RuntimeError(
+            "autotune CE sweep refused on the CPU platform (defaults-only "
+            "path): interpret-mode timings are meaningless and tier-1 CI "
+            "must stay hermetic — use ce_chunk_for() for the fallback chunk")
+    cands = ce_chunk_candidates(v)
+    if not cands:
+        return ce_chunk_for(n=n, d=d, v=v, dtype=dtype, platform=plat)
+    if measure is None:
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_tensorflow_guide_tpu.ops import fused_ce as fce
+
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        x = jax.random.normal(keys[0], (n, d), jnp.float32).astype(dtype)
+        kernel = jax.random.normal(keys[1], (d, v), jnp.float32) * 0.02
+        targets = jax.random.randint(keys[2], (n,), 0, v, jnp.int32)
+
+        def measure(chunk):  # noqa: F811 - documented injection point
+            f = jax.jit(jax.value_and_grad(
+                lambda xx, kk: fce.fused_cross_entropy(
+                    xx, kk, targets, chunk=chunk),
+                argnums=(0, 1)))
+            return measure_runner(lambda: f(x, kernel), iters=iters)
+
+    timed: dict[int, float] = {}
+    failed: list[dict] = []
+    for chunk in cands:
+        try:
+            timed[chunk] = float(measure(chunk))
+        except Exception as e:  # noqa: BLE001 - record and move on
+            failed.append({"chunk": chunk, "error": str(e)[:200]})
+    if not timed:
+        return ce_chunk_for(n=n, d=d, v=v, dtype=dtype, platform=plat)
+    best = min(timed, key=timed.get)
+    detail = {
+        "iters": iters,
+        "swept": [{"chunk": c, "secs_per_call": round(t, 7)}
+                  for c, t in sorted(timed.items())],
+    }
+    if failed:
+        detail["failed"] = failed
+    ce_record(n=n, d=d, v=v, dtype=dtype, chunk=best, detail=detail,
+              platform=plat)
+    return best
 
 
 # --------------------------------------------------------------------------

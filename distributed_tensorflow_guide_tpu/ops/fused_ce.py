@@ -1,0 +1,242 @@
+"""Chunked fused next-token cross-entropy — the LM-head HBM-traffic diet.
+
+The round-5 on-chip capture put GPT-2 pipeline MFU at 0.36–0.40 with the
+loss path as the dominant traffic term: ``PipelinedLM._mb_loss``
+materialized the full ``(B, S, 50304)`` fp32 logits AND a second full-size
+``log_softmax`` copy per microbatch — at the judged shape that is ~7
+full-logit HBM passes per step (closed form:
+``benchmarks.common.loss_bytes_model``), dwarfing the transformer trunk.
+This module is the fix family Megatron-LM's vocab-parallel loss and the
+Liger-kernel-style fused CE established: **never materialize the logits** —
+run the head matmul, online log-sum-exp, target gather, and grad-of-logits
+(``softmax − onehot``) per VOCAB CHUNK, so the largest loss intermediate in
+forward OR backward is one ``(N, chunk)`` f32 tile.
+
+Design:
+
+* ``custom_vjp`` with a hand-written backward: the forward keeps only
+  ``(x, kernel, targets, lse)`` as residuals (the lse vector is ``N`` f32
+  scalars — the thing a naive ``jax.grad`` would have saved is the ``(N, V)``
+  log-softmax); the backward re-runs the chunk matmuls and emits
+  ``dz = softmax − onehot`` tile by tile, feeding the two grad matmuls
+  without a full-vocab tensor ever going live. The recompute is one extra
+  head matmul — cheap against the ~7 full-logit HBM passes it removes on a
+  bandwidth-bound step.
+* matmuls run in the ACTIVATION dtype with f32 accumulation
+  (``preferred_element_type``): bf16 activations ⇒ bf16 MXU passes, f32
+  loss/grads — the precision-policy contract (``core/precision.py``).
+* one implementation serves tp=1 AND vocab parallelism: pass ``axis`` and
+  each device runs the same chunk loop over its ``V/tp`` kernel shard with
+  global target ids; the forward assembles ``lse``/target-logit with a
+  pmax + two psums (the Megatron scalar-field triple) and the backward
+  psums ``dx`` explicitly — subsuming the old
+  ``PipelinedLM._mb_loss_vocab_parallel``.
+* the chunk size resolves through the autotune table
+  (``ops/autotune.py ce_chunk_for`` — same persistence, same platform
+  keying, same CPU defaults-only hermeticity contract as the flash block
+  table); a miss falls back to the tested ``DEFAULT_CE_CHUNK``.
+
+Numerical contract (pinned in tests/test_fused_ce.py and the fused
+pipeline gradient-identity tests): loss and all grads match the naive
+log_softmax path within dtype tolerance, at tp=1 and under vocab
+parallelism, and the fused backward jaxpr contains no ``(N, V)`` f32
+intermediate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.ops.autotune import (
+    DEFAULT_CE_CHUNK,
+    ce_chunk_for,
+)
+
+
+def resolve_fused_ce(setting, *, vocab_size: int | None = None,
+                     platform: str | None = None) -> bool:
+    """Resolve a ``fused_ce="auto"|True|False`` knob to a bool.
+
+    ``auto`` is ON exactly where the diet pays: a TPU backend (the measured
+    bandwidth-bound regime this layer attacks) with a vocab big enough to
+    chunk. It is OFF on CPU — tier-1 CI keeps tracing the byte-identical
+    legacy program, the same hermeticity posture as the autotune
+    defaults-only path — and for vocabs at or under one default chunk,
+    where chunking is degenerate. The battery A/B rows pin the knob
+    explicitly on both sides so the on-chip capture adjudicates the
+    policy, not the default.
+    """
+    if isinstance(setting, bool):
+        return setting
+    s = str(setting).lower()
+    if s in ("on", "true", "1"):
+        return True
+    if s in ("off", "false", "0"):
+        return False
+    if s != "auto":
+        raise ValueError(
+            f"fused_ce must be 'auto', on/True or off/False, got {setting!r}")
+    plat = platform
+    if plat is None:
+        plat = jax.default_backend()
+    if plat != "tpu":
+        return False
+    return vocab_size is None or vocab_size > DEFAULT_CE_CHUNK
+
+
+def _chunk_bounds(v_local: int, chunk: int) -> list[tuple[int, int]]:
+    """Static [lo, hi) column windows — the last one may be ragged, which
+    static slicing handles for free (no padding, no masking of the lse)."""
+    return [(lo, min(lo + chunk, v_local))
+            for lo in range(0, v_local, chunk)]
+
+
+def _dot_f32(a, b, ct, dims):
+    """dot_general in the compute dtype ``ct`` with f32 accumulation — the
+    one matmul spelling every chunk pass uses (bf16 MXU, f32 out)."""
+    return lax.dot_general(a.astype(ct), b.astype(ct), (dims, ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_nll(chunk: int, axis: str | None):
+    """The custom-VJP'd primitive: SUM of next-token NLL over the N rows
+    (reduction to mean happens OUTSIDE, so its gradient is ordinary
+    autodiff). Cached per (chunk, axis) so retraces share one custom_vjp
+    identity, like the pipeline schedule tables."""
+
+    def chunked_stats(x, kernel, targets):
+        """One pass over the vocab chunks: running (max, sumexp) log-sum-exp
+        state + the target logit (owned by exactly one chunk — and, under
+        vocab parallelism, exactly one shard)."""
+        n = x.shape[0]
+        v_local = kernel.shape[1]
+        ct = x.dtype
+        f32 = jnp.float32
+        offset = lax.axis_index(axis) * v_local if axis is not None else 0
+        m = jnp.full((n,), -jnp.inf, f32)
+        s = jnp.zeros((n,), f32)
+        zt = jnp.zeros((n,), f32)
+        for lo, hi in _chunk_bounds(v_local, chunk):
+            z = _dot_f32(x, kernel[:, lo:hi], ct, (((1,), (0,))))  # (n, ck)
+            m_new = jnp.maximum(m, jnp.max(z, axis=-1))
+            s = s * jnp.exp(m - m_new) + jnp.sum(
+                jnp.exp(z - m_new[:, None]), axis=-1)
+            m = m_new
+            t = targets - (offset + lo)
+            ok = (t >= 0) & (t < hi - lo)
+            zt = zt + jnp.where(
+                ok,
+                jnp.take_along_axis(
+                    z, jnp.clip(t, 0, hi - lo - 1)[:, None], axis=-1
+                )[:, 0],
+                0.0,
+            )
+        return m, s, zt
+
+    def value_and_residuals(x, kernel, targets):
+        m, s, zt = chunked_stats(x, kernel, targets)
+        if axis is not None:
+            # Megatron scalar-field triple: max (stability), sum-exp
+            # (partition function), target logit (one shard owns it). All
+            # inside the custom fwd, so no differentiation rule is needed
+            # for pmax and the backward's collective discipline is explicit.
+            mg = cc.pmax(m, axis)
+            s = cc.psum(s * jnp.exp(m - mg), axis)
+            zt = cc.psum(zt, axis)
+            m = mg
+        lse = jnp.log(s) + m
+        return jnp.sum(lse - zt), (x, kernel, targets, lse)
+
+    @jax.custom_vjp
+    def f(x, kernel, targets):
+        return value_and_residuals(x, kernel, targets)[0]
+
+    def fwd(x, kernel, targets):
+        return value_and_residuals(x, kernel, targets)
+
+    def bwd(res, g):
+        x, kernel, targets, lse = res
+        n, _ = x.shape
+        v_local = kernel.shape[1]
+        ct = x.dtype
+        f32 = jnp.float32
+        offset = lax.axis_index(axis) * v_local if axis is not None else 0
+        g32 = g.astype(f32)
+        dx = jnp.zeros(x.shape, f32)
+        dw_chunks = []
+        for lo, hi in _chunk_bounds(v_local, chunk):
+            w_c = kernel[:, lo:hi]
+            z = _dot_f32(x, w_c, ct, (((1,), (0,))))         # recompute
+            p = jnp.exp(z - lse[:, None])                    # softmax
+            t = targets - (offset + lo)
+            ok = (t >= 0) & (t < hi - lo)
+            oh = (t[:, None] == jnp.arange(hi - lo)[None, :]) & ok[:, None]
+            dz = ((p - oh.astype(f32)) * g32).astype(ct)     # (n, ck)
+            dx = dx + _dot_f32(dz, w_c, ct, (((1,), (1,))))  # (n, d)
+            dw_chunks.append(_dot_f32(x, dz, ct, (((0,), (0,)))))  # (d, ck)
+        dw = (jnp.concatenate(dw_chunks, axis=1)
+              if len(dw_chunks) > 1 else dw_chunks[0])
+        if axis is not None:
+            # dx sums every shard's vocab-slice contribution (the job the
+            # old path gave tp_identity's backward psum); dW stays local —
+            # it IS the shard's gradient.
+            dx = cc.psum(dx, axis)
+        return (dx.astype(x.dtype), dw.astype(kernel.dtype),
+                np.zeros(targets.shape, jax.dtypes.float0))
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def fused_cross_entropy(x, kernel, targets, *, chunk: int | None = None,
+                        axis: str | None = None,
+                        reduction: str = "mean"):
+    """Chunked cross-entropy ``-log softmax(x @ kernel)[targets]``.
+
+    x: ``(..., D)`` activations (post-LN); kernel: ``(D, V_local)`` —
+    the full vocab at tp=1 or this device's shard under ``axis``-vocab
+    parallelism; targets: ``(...)`` GLOBAL int ids, same leading shape
+    as ``x``. Returns the mean (default) or sum NLL as f32; no ``(N, V)``
+    tensor is live in forward or backward. ``chunk=None`` resolves
+    through the autotune table (CPU: the tested static fallback).
+    """
+    if reduction not in ("mean", "sum"):
+        raise ValueError(f"reduction must be 'mean' or 'sum', got "
+                         f"{reduction!r}")
+    if x.shape[:-1] != targets.shape:
+        raise ValueError(
+            f"targets shape {targets.shape} must match x leading shape "
+            f"{x.shape[:-1]}")
+    d = x.shape[-1]
+    if kernel.ndim != 2 or kernel.shape[0] != d:
+        raise ValueError(
+            f"kernel must be (D={d}, V_local), got {kernel.shape}")
+    x2 = x.reshape(-1, d)
+    t1 = targets.reshape(-1)
+    v_local = kernel.shape[1]
+    if chunk is None:
+        chunk = ce_chunk_for(n=x2.shape[0], d=d, v=v_local, dtype=x.dtype)
+    chunk = max(1, min(int(chunk), v_local))
+    total = _fused_nll(chunk, axis)(x2, kernel, t1)
+    if reduction == "sum":
+        return total
+    return total / x2.shape[0]
+
+
+def fused_next_token_loss(x, kernel, tokens, *, chunk: int | None = None,
+                          axis: str | None = None,
+                          reduction: str = "mean"):
+    """Next-token LM loss from pre-head hidden states: positions ``:-1``
+    predict tokens ``1:`` — the shift every naive loss call site applies
+    to its logits, applied here to the (much smaller) hidden states."""
+    return fused_cross_entropy(
+        x[:, :-1], kernel, tokens[:, 1:], chunk=chunk, axis=axis,
+        reduction=reduction)

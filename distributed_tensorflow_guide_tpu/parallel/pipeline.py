@@ -461,9 +461,18 @@ class PipelinedLM:
 
     def __init__(self, mesh: Mesh, cfg: TransformerConfig,
                  num_microbatches: int, schedule: str = "gpipe",
-                 virtual_chunks: int = 1):
+                 virtual_chunks: int = 1, fused_ce="auto",
+                 ce_chunk: int | None = None, precision=None):
         if schedule not in ("auto", "gpipe", "1f1b"):
             raise ValueError(f"unknown pipeline schedule {schedule!r}")
+        if precision is not None:
+            # core/precision.py policy: one object sets activation dtype +
+            # the selective-remat mode instead of per-call-site dtypes
+            from distributed_tensorflow_guide_tpu.core import (
+                precision as precision_mod,
+            )
+
+            cfg = precision_mod.resolve(precision).apply_to_transformer(cfg)
         sizes = axis_sizes(mesh)
         if schedule == "auto":
             # Measured policy (round-5 on-chip battery): at pipe=1 the 1F1B
@@ -520,6 +529,25 @@ class PipelinedLM:
         self.embedder = _Embedder(cfg)
         self.head = _Head(cfg)
         self.block = Block(cfg)
+        # Chunked fused cross-entropy (ops/fused_ce.py): the loss and its
+        # grad-of-logits run per vocab chunk, so the last stage never
+        # materializes (mb, S, V) logits — fwd OR bwd. One implementation
+        # serves tp=1 and tp>1 (where it subsumes the vocab-parallel path:
+        # same chunk loop per shard + the Megatron collective triple).
+        # Resolution is per resolve_fused_ce ("auto": TPU + chunkable
+        # vocab); the schedules all dispatch through _mb_loss, so the
+        # gradient-identity contract is preserved by construction.
+        from distributed_tensorflow_guide_tpu.ops.fused_ce import (
+            resolve_fused_ce,
+        )
+
+        self.fused_ce = resolve_fused_ce(fused_ce,
+                                         vocab_size=cfg.vocab_size)
+        self.ce_chunk = ce_chunk
+        # raw LN for the explicit-params head paths (fused CE at any tp;
+        # vocab-parallel CE at tp>1) — the _Head module computes full-vocab
+        # logits, which is exactly what those paths avoid
+        self._head_ln = nn.LayerNorm(dtype=cfg.dtype)
         # 3D parallelism (dp x tp x pp): when the mesh's ``model`` axis is
         # >1, each pipeline stage's blocks are Megatron-TP-sharded over it —
         # qkv/up kernels column-parallel (heads / d_ff dims), proj/down
@@ -531,8 +559,10 @@ class PipelinedLM:
         # its (heads/tp, d_ff/tp) shard. The vocab-sized tables shard too:
         # the token embedding is a Megatron parallel embedding
         # (:meth:`_embed_tokens`) and the LM head computes vocab-parallel
-        # cross-entropy (:meth:`_mb_loss_vocab_parallel`) — no device holds
-        # a full-vocab table or materializes full-vocab logits.
+        # cross-entropy (:meth:`_mb_loss_fused` with axis="model", or the
+        # naive :meth:`_mb_loss_vocab_parallel` when fused CE is off) — no
+        # device holds a full-vocab table or materializes full-vocab
+        # logits.
         self.tp = sizes["model"]
         if self.tp > 1:
             if cfg.vocab_size % self.tp:
@@ -550,10 +580,6 @@ class PipelinedLM:
                 lambda path, _: self._stage_leaf_spec(path),
                 nn.meta.unbox(abs_block),
             )
-            # vocab-parallel cross-entropy needs the raw LN to apply with
-            # explicit params (the _Head module computes full-vocab logits,
-            # which is exactly what vocab parallelism avoids)
-            self._head_ln = nn.LayerNorm(dtype=cfg.dtype)
         else:
             self.block_apply = self.block
 
@@ -713,22 +739,27 @@ class PipelinedLM:
     def _stage_apply(self, stage_params, x):
         """Run this stage's layer blocks (scan over the stack's rows).
 
-        ``cfg.remat`` reaches the autodiff schedules here: the scan body is
-        checkpointed per block, so GPipe/interleaved backward recomputes
-        block internals from block boundaries instead of storing every
-        intermediate — the same memory contract 1F1B gets from its manual
-        per-stage recompute. The knob is deliberately NOT applied under
-        1F1B: its VJP already recomputes from the saved stage input, and
-        checkpointing on top would just re-run each block once more per
-        backward tick for no residual-memory gain. prevent_cse=False as in
-        models/transformer.py — the body lives inside lax.scan, where the
-        CSE barriers are unnecessary.
+        The remat mode (``cfg.resolved_remat_mode``, settable through a
+        core/precision.py policy) reaches the autodiff schedules here:
+        under "block" the scan body is checkpointed per block, so
+        GPipe/interleaved backward recomputes block internals from block
+        boundaries instead of storing every intermediate — the same memory
+        contract 1F1B gets from its manual per-stage recompute. The knob is
+        deliberately NOT applied under 1F1B: its VJP already recomputes
+        from the saved stage input, and checkpointing on top would just
+        re-run each block once more per backward tick for no
+        residual-memory gain. The "attention" mode (checkpoint only the
+        attention sub-layer) lives INSIDE Block, so it applies uniformly to
+        every schedule including 1F1B's per-tick recompute.
+        prevent_cse=False as in models/transformer.py — the body lives
+        inside lax.scan, where the CSE barriers are unnecessary.
         """
 
         def body(h, layer_params):
             return self.block_apply.apply({"params": layer_params}, h), None
 
-        if self.cfg.remat and self.schedule != "1f1b":
+        if (self.cfg.resolved_remat_mode == "block"
+                and self.schedule != "1f1b"):
             body = jax.checkpoint(body, prevent_cse=False)
         out, _ = lax.scan(body, x, stage_params)
         return out
@@ -790,8 +821,13 @@ class PipelinedLM:
 
         The single definition shared by every schedule — the schedules are
         contractually gradient-identical, so the loss math must not fork.
-        Under TP it dispatches to the vocab-parallel cross-entropy.
+        With ``fused_ce`` on, the chunked fused cross-entropy serves tp=1
+        AND tp>1 (one implementation, ``axis`` toggles the Megatron
+        collectives); otherwise TP dispatches to the naive vocab-parallel
+        cross-entropy and tp=1 to the full-logits head.
         """
+        if self.fused_ce:
+            return self._mb_loss_fused(head_params, x, toks)
         if self.tp > 1:
             return self._mb_loss_vocab_parallel(head_params, x, toks)
         logits = self.head.apply({"params": head_params}, x)
@@ -800,6 +836,24 @@ class PipelinedLM:
             logp, toks[:, 1:][..., None], axis=-1
         )[..., 0]
         return -jnp.mean(ll)
+
+    def _mb_loss_fused(self, head_params, x, toks):
+        """Chunked fused CE (ops/fused_ce.py): head matmul, online
+        log-sum-exp, target gather and grad-of-logits all run per vocab
+        chunk under one custom_vjp — no (mb, S, V) tensor live in fwd or
+        bwd, which at GPT-2's 50304 vocab is the last stage's dominant
+        HBM term. Under tp>1 the kernel is this device's vocab shard and
+        ``axis="model"`` turns on the collective triple + dx psum,
+        subsuming :meth:`_mb_loss_vocab_parallel`."""
+        from distributed_tensorflow_guide_tpu.ops.fused_ce import (
+            fused_next_token_loss,
+        )
+
+        xh = self._head_ln.apply({"params": head_params["ln_f"]}, x)
+        kernel = head_params["lm_head"]["kernel"]  # (D, V/tp) local shard
+        return fused_next_token_loss(
+            xh, kernel, toks, chunk=self.ce_chunk,
+            axis="model" if self.tp > 1 else None)
 
     def _mb_loss_vocab_parallel(self, head_params, x, toks):
         """Megatron vocab-parallel cross-entropy (Shoeybi et al. 2019 §3):

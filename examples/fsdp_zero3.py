@@ -33,6 +33,11 @@ def main() -> None:
     ap.add_argument("--vocab", type=int, default=1024)
     ap.add_argument("--seq-len", type=int, default=64)
     ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--fused-ce", choices=["auto", "on", "off"],
+                    default="auto",
+                    help="chunked fused cross-entropy for the LM loss "
+                         "(ops/fused_ce.py; 'auto' = on for TPU + "
+                         "chunkable vocab)")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -92,7 +97,8 @@ def main() -> None:
     )
     st_sh = fsdp.state_shardings(state, shardings)
     state = jax.device_put(state, st_sh)
-    step = fsdp.make_train_step(make_lm_loss_fn(model), st_sh)
+    step = fsdp.make_train_step(
+        make_lm_loss_fn(model, fused_ce=args.fused_ce), st_sh)
 
     rng = np.random.RandomState(0)
     first = last = None

@@ -43,6 +43,16 @@ def main() -> None:
     ap.add_argument("--temperature", type=float, default=0.0,
                     help="0 = greedy")
     ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument("--fused-ce", choices=["auto", "on", "off"],
+                    default="auto",
+                    help="chunked fused cross-entropy for the training "
+                         "loss (ops/fused_ce.py): no (B, S, V) logits "
+                         "live; 'auto' = on for TPU + chunkable vocab")
+    ap.add_argument("--precision", default="auto",
+                    choices=["auto", "f32", "bf16", "bf16_remat",
+                             "bf16_remat_attn"],
+                    help="mixed-precision policy (core/precision.py); "
+                         "'auto' keeps this demo's f32")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -117,12 +127,17 @@ def main() -> None:
         num_layers=args.layers, num_heads=args.heads,
         d_model=args.d_model, d_ff=4 * args.d_model,
         max_len=args.seq_len, causal=True, dtype=jnp.float32)
+    if args.precision != "auto":
+        from distributed_tensorflow_guide_tpu.core import precision as prec
+
+        cfg = prec.resolve(args.precision).apply_to_transformer(cfg)
     model = Transformer(cfg)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, cfg.max_len), jnp.int32))["params"]
     state = dp.replicate(train_state.TrainState.create(
         apply_fn=model.apply, params=params, tx=optax.adam(args.lr)))
-    step = dp.make_train_step(make_lm_loss_fn(model))
+    step = dp.make_train_step(make_lm_loss_fn(model,
+                                              fused_ce=args.fused_ce))
 
     for i in range(args.steps):
         batch = dp.shard_batch(loader.next_batch())

@@ -42,6 +42,21 @@ def main() -> None:
     ap.add_argument("--model-parallel", type=int, default=1,
                     help="TP degree inside each stage (Megatron f/g; the "
                          "LM head goes vocab-parallel) — 3D dp x tp x pp")
+    ap.add_argument("--fused-ce", choices=["auto", "on", "off"],
+                    default="auto",
+                    help="chunked fused cross-entropy (ops/fused_ce.py): "
+                         "loss + grad-of-logits per vocab chunk, no "
+                         "(B, S, V) logits live. 'auto' resolves on for "
+                         "TPU + chunkable vocab, off on CPU (the resolved "
+                         "setting is printed)")
+    ap.add_argument("--precision", default="auto",
+                    choices=["auto", "f32", "bf16", "bf16_remat",
+                             "bf16_remat_attn"],
+                    help="mixed-precision policy (core/precision.py): "
+                         "params f32 / activations per policy / loss+accum "
+                         "f32, incl. the selective-remat knob "
+                         "(bf16_remat_attn checkpoints attention only). "
+                         "'auto' keeps this script's per-config dtypes")
     ap.add_argument("--data", default=None, metavar="CORPUS",
                     help="text file to train on: byte-level BPE is trained "
                          "(or loaded from CORPUS.vocab.json), the corpus is "
@@ -140,7 +155,13 @@ def main() -> None:
         )
     pp = PipelinedLM(mesh, cfg, num_microbatches=args.microbatches,
                      schedule=args.schedule,
-                     virtual_chunks=args.virtual_chunks)
+                     virtual_chunks=args.virtual_chunks,
+                     fused_ce=args.fused_ce,
+                     precision=None if args.precision == "auto"
+                     else args.precision)
+    cfg = pp.cfg  # precision policy may have rewritten dtype/remat
+    print(f"fused_ce={pp.fused_ce} (requested {args.fused_ce!r}), "
+          f"precision={args.precision}")
     params = pp.init_params(jax.random.PRNGKey(0))
     n_params = sum(p.size for p in jax.tree.leaves(params))
     tx = optax.adam(args.lr)
@@ -177,7 +198,12 @@ def main() -> None:
         tokens_fixed = rng.randint(
             0, cfg.vocab_size, (global_batch, cfg.max_len)
         ).astype(np.int32)
-        batches = iter(lambda: tokens_fixed, None)
+        # NOT iter(lambda: ..., None): the 2-arg iter compares each yield
+        # to the sentinel with ==, which on a numpy array is elementwise
+        # and raises at the first next()
+        import itertools
+
+        batches = itertools.repeat(tokens_fixed)
     if args.virtual_chunks > 1:
         # interleaved: bubble from the actual schedule, in full-stage units
         # (each tick costs 1/v of a stage)
@@ -213,8 +239,9 @@ def main() -> None:
         )
 
         serving = pp.to_serving_params(jax.device_get(params))
-        gen = make_generate_fn(dataclasses.replace(cfg, remat=False),
-                               max_new_tokens=args.max_new, temperature=0.0)
+        gen = make_generate_fn(
+            dataclasses.replace(cfg, remat=False, remat_mode=None),
+            max_new_tokens=args.max_new, temperature=0.0)
         ids = np.asarray([tokenizer.encode(args.generate.encode())], np.int32)
         out = np.asarray(gen(serving, ids, jax.random.PRNGKey(0)))
         print("generated:", tokenizer.decode(out[0].tolist()))
