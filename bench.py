@@ -144,6 +144,13 @@ def run_bench() -> None:
     # default (judged config unchanged); battery row resnet_fused_bn pins
     # it on, echoed in the JSON line like every A/B knob.
     fused_bn = os.environ.get("BENCH_FUSED_BN", "0") == "1"
+    # bucketed-backward all-reduce A/B (round 9): per-bucket custom_vjp
+    # markers emit each gradient bucket's pmean mid-backward so XLA can
+    # overlap it with the remaining backward compute
+    # (parallel/overlap.py). Off by default (judged config unchanged —
+    # and on ONE chip the data axis has no wire traffic to hide); battery
+    # row dp_overlap pins it on, echoed in the JSON like every A/B knob.
+    overlap_setting = os.environ.get("BENCH_OVERLAP", "off")
     global_batch = per_chip_batch * n_dev
     image_size = 224
 
@@ -157,7 +164,7 @@ def run_bench() -> None:
     steps_per_call = int(os.environ.get("BENCH_SPC", "8"))
 
     mesh = build_mesh(MeshSpec(data=-1))
-    dp = DataParallel(mesh)
+    dp = DataParallel(mesh, overlap=overlap_setting)
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, remat=remat,
                      fused_bn=fused_bn)
 
@@ -291,6 +298,7 @@ def run_bench() -> None:
                 "per_chip_batch": per_chip_batch,
                 "remat": remat,
                 "fused_bn": fused_bn,
+                "overlap": dp.overlap,
                 **extras,
                 **mfu_extras(step_flops, 1, dt_per_step, a100_mfu=None),
             }
@@ -436,6 +444,18 @@ def main() -> int:
     if "--fused-bn" in sys.argv:
         os.environ["BENCH_FUSED_BN"] = "1"
         sys.argv = [a for a in sys.argv if a != "--fused-bn"]
+    # --overlap on|off|auto: argv spelling of BENCH_OVERLAP so the battery
+    # can pin the A/B row; inherited by the orchestrator's children via env.
+    if "--overlap" in sys.argv:
+        i = sys.argv.index("--overlap")
+        try:
+            setting = sys.argv[i + 1]
+        except IndexError:
+            sys.exit("--overlap requires a value (on|off|auto)")
+        if setting not in ("on", "off", "auto"):
+            sys.exit(f"--overlap must be on|off|auto, got {setting!r}")
+        os.environ["BENCH_OVERLAP"] = setting
+        del sys.argv[i:i + 2]
     if "--probe" in sys.argv:
         probe()
         return 0

@@ -272,6 +272,93 @@ def device_hbm_peak() -> float | None:
     return _device_peak(_TPU_HBM_PEAK)
 
 
+# Per-chip aggregate ICI bandwidth (bytes/s, all links, one direction) from
+# the public spec sheets, same device_kind substring keying as the FLOP/HBM
+# tables: v5e 1600 Gbps, v5p 4800 Gbps, v4 2400 Gbps, v6e 3584 Gbps; v2/v3
+# from the older system-architecture tables. Like every peak here this is
+# the ROOFLINE denominator — a measured ici_roofline_frac near 1.0 means
+# the collective is wire-bound, near 0 means launch/exposure-bound (the
+# overlap layer's tuning signal).
+_TPU_ICI_PEAK: dict[str, float] = {
+    "v5 lite": 200e9, "v5litepod": 200e9, "v5e": 200e9,
+    "v5p": 600e9,
+    "v6 lite": 448e9, "v6e": 448e9,
+    "v4": 300e9,
+    "v3": 82e9,
+    "v2": 62e9,
+}
+
+
+def device_ici_peak() -> float | None:
+    """Per-chip ICI bandwidth (bytes/s) of the attached accelerator, or
+    None off-TPU — same contract as :func:`device_peak_flops`."""
+    return _device_peak(_TPU_ICI_PEAK)
+
+
+# --- closed-form per-device collective traffic (the comm_bytes_model) -----
+#
+# Ring-algorithm accounting, per device, per step: what bench_comm_overlap
+# divides measured comm time into to get ici_gb_per_s. Like the HBM byte
+# models these are MINIMAL algorithmic traffic — a sub-ring XLA picks, or
+# retransmits, push the measured fraction DOWN, which is the signal.
+
+
+def dp_allreduce_bytes(grad_bytes: float, world: int) -> float:
+    """Sync-DP gradient all-reduce: ring = reduce-scatter + all-gather,
+    each moving (n−1)/n of the buffer per device — 2·P·(n−1)/n. Zero on a
+    1-device axis (lax.pmean compiles to a no-op there)."""
+    if world <= 1:
+        return 0.0
+    return 2.0 * grad_bytes * (world - 1) / world
+
+
+def fsdp_comm_bytes(sharded_param_bytes: float, world: int,
+                    replicated_grad_bytes: float = 0.0) -> float:
+    """ZeRO-3 per-step traffic AS THIS REPO SCHEDULES IT: all-gather the
+    sharded params for the forward — the gathered copies then live as
+    autodiff residuals through the backward (parallel/overlap.py
+    gather_shard saves no residual of its own; the downstream matmul VJPs
+    hold the full params, trading memory for the re-gather classic
+    ZeRO-3 pays) — and reduce-scatter the gradients = 2 one-way passes at
+    (n−1)/n each; replicated leaves' gradients still pay the plain 2-pass
+    all-reduce. Pinned against the traced schedule (one all_gather + one
+    reduce_scatter per sharded leaf) in tests/test_overlap.py."""
+    if world <= 1:
+        return 0.0
+    frac = (world - 1) / world
+    return (2.0 * sharded_param_bytes
+            + 2.0 * replicated_grad_bytes) * frac
+
+
+def pipeline_ppermute_bytes(act_bytes: float, num_microbatches: int,
+                            stages: int) -> float:
+    """Pipeline-parallel traffic: each microbatch's activation crosses
+    every stage boundary once forward, its gradient once backward —
+    2·M·act·(P−1)/P per device, ring-averaged (the P-th hop is the wrap
+    that carries no payload). Matches
+    ``PipelinedLM.ppermute_bytes_per_step`` (pinned)."""
+    if stages <= 1:
+        return 0.0
+    return 2.0 * num_microbatches * act_bytes * (stages - 1) / stages
+
+
+def ici_extras(comm_bytes: float, comm_secs: float | None) -> dict:
+    """Extra report() keys for interconnect-honest benches: the closed-form
+    per-device comm bytes, and — when the caller measured the comm time
+    (e.g. overlap-off minus compute-floor) — the achieved wire rate and
+    the fraction of the attached part's ICI peak (emitted only on real
+    hardware, like :func:`mfu_extras`)."""
+    out: dict = {"comm_bytes": round(float(comm_bytes), 1),
+                 "comm_gb": round(comm_bytes / 1e9, 4)}
+    if comm_secs is not None and comm_secs > 0 and comm_bytes > 0:
+        achieved = comm_bytes / comm_secs
+        out["ici_gb_per_s"] = round(achieved / 1e9, 2)
+        peak = device_ici_peak()
+        if peak:
+            out["ici_roofline_frac"] = round(achieved / peak, 4)
+    return out
+
+
 def roofline_extras(flops_per_step: float | None,
                     hbm_bytes_per_step: float | None,
                     steps: int, dt: float, n_devices: int = 1) -> dict:

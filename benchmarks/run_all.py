@@ -27,6 +27,7 @@ BENCHES = [
     "bench_generate.py",      # serving: KV-cache decode tokens/sec
     "bench_flash_kernel.py",  # kernel-only flash/carry roofline fractions
     "bench_fused_ce.py",      # LM-head loss alone: naive vs chunked fused CE
+    "bench_comm_overlap.py",  # ICI overlap: exposed-comm fraction A/B
 ]
 
 # Tiny fake-device configs, small enough for CPU (also used by
@@ -92,6 +93,12 @@ SMOKE = {
         # closed-form traffic keys; timings meaningless (off-TPU skip-JSON
         # contract covers the no-flag real-mode path)
         ["--fake-devices", "1", "--small"],
+    "bench_comm_overlap.py":
+        # CPU liveness on an 8-fake-device data axis: the bucketed-overlap
+        # step, the monolithic step and the no-collective floor all run
+        # and the comm_bytes/exposed_comm_frac keys are emitted; timings
+        # meaningless (off-TPU skip-JSON contract covers real mode)
+        ["--fake-devices", "8", "--small"],
 }
 
 

@@ -30,10 +30,19 @@ ROOT = Path(__file__).resolve().parents[1]
 
 # (name, argv, timeout_s) — argv relative to repo root.
 BATTERY: list[tuple[str, list[str], int]] = [
-    ("resnet_flagship", ["bench.py"], 2400),
+    # round 9: every DP continuity row pins --overlap off explicitly — the
+    # bucketed backward all-reduce must never flip a number of record by
+    # default (the round-7 one-variable lesson); the dp_overlap row below
+    # is argv-identical except the knob and carries the A/B
+    ("resnet_flagship", ["bench.py", "--overlap", "off"], 2400),
     # fused BN+ReLU A/B vs the flagship row above (round 8): the ONLY
     # changed variable is the BN path — same batch, same sustained mode
-    ("resnet_fused_bn", ["bench.py", "--fused-bn"], 2400),
+    ("resnet_fused_bn", ["bench.py", "--fused-bn", "--overlap", "off"],
+     2400),
+    # bucketed-overlap A/B vs the flagship: the one changed variable is
+    # the gradient-reduction schedule (single chip: world=1 makes this a
+    # no-op pair — the row exists so a multi-chip capture slots in)
+    ("dp_overlap", ["bench.py", "--overlap", "on"], 2400),
     # bench_gpt2_pp's default schedule is now "auto" (GPipe at pipe=1, the
     # measured record config); the 1F1B rows pin it explicitly so the A/B
     # stays an A/B. Round 8: every continuity row ALSO pins --fused-ce off
@@ -88,6 +97,24 @@ BATTERY: list[tuple[str, list[str], int]] = [
       "--seq-len", "2048", "--microbatch-size", "1",
       "--fused-ce", "off"], 1800),
     ("bert_tp", ["benchmarks/bench_bert_tp.py"], 1800),
+    # ICI overlap microbench (round 9): --tune sweeps the gradient-bucket
+    # candidates and records the winner BEFORE the headline rows; each row
+    # measures the full on/off/compute-floor triple and emits the
+    # exposed-comm fraction + ICI roofline fields — the flag only selects
+    # the headline side, so the comm_overlap_*/overlapped pairs are
+    # argv-identical except the one knob
+    ("comm_overlap_dp",
+     ["benchmarks/bench_comm_overlap.py", "--mode", "dp", "--tune",
+      "--overlap", "off"], 1800),
+    ("dp_overlap_kernel",
+     ["benchmarks/bench_comm_overlap.py", "--mode", "dp", "--tune",
+      "--overlap", "on"], 1800),
+    ("comm_overlap_fsdp",
+     ["benchmarks/bench_comm_overlap.py", "--mode", "fsdp",
+      "--fsdp-prefetch", "off"], 1800),
+    ("fsdp_prefetch",
+     ["benchmarks/bench_comm_overlap.py", "--mode", "fsdp",
+      "--fsdp-prefetch", "on"], 1800),
     ("gpt2_decode", ["benchmarks/bench_generate.py"], 1800),
     # decode-roofline A/B: scan unroll (the donation default is already on)
     ("gpt2_decode_unroll4",
@@ -175,17 +202,34 @@ def main() -> None:
         missing = set(args.only) - {b[0] for b in todo}
         if missing:
             sys.exit(f"unknown battery names: {sorted(missing)}")
+    if not todo:
+        # ADVICE round 5: an empty battery_*.jsonl got committed as if it
+        # were evidence — never create an artifact with nothing to record
+        sys.exit("run_battery: empty selection, refusing to create an "
+                 "empty artifact")
 
     outdir = ROOT / "bench_results"
     outdir.mkdir(exist_ok=True)
     stamp = time.strftime("%Y%m%d_%H%M%S")
     path = Path(args.out) if args.out else outdir / f"battery_{stamp}.jsonl"
     n_ok = 0
-    with open(path, "a") as out:
-        out.write(json.dumps(
-            {"battery_start": stamp, "n_benches": len(todo)}) + "\n")
-        for name, argv, timeout in todo:
-            n_ok += run_one(name, argv, timeout, out)
+    n_recs = 0  # bench records actually written (run_one writes one each)
+    try:
+        with open(path, "a") as out:
+            out.write(json.dumps(
+                {"battery_start": stamp, "n_benches": len(todo)}) + "\n")
+            for name, argv, timeout in todo:
+                n_ok += run_one(name, argv, timeout, out)
+                n_recs += 1
+    finally:
+        # same ADVICE item, the belt to the selection check's suspenders:
+        # the loop can die BEFORE any bench record lands (the first spawn
+        # raises, ctrl-C during bench 1) and a header-only artifact reads
+        # as "a battery ran here" to anyone listing bench_results/ —
+        # remove it on the way out (once a real record exists the partial
+        # artifact is genuine evidence and stays)
+        if n_recs == 0 and path.exists():
+            path.unlink()
     print(f"[battery] {n_ok}/{len(todo)} ok -> {path}", file=sys.stderr)
 
 

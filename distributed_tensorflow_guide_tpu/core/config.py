@@ -39,6 +39,10 @@ class RunConfig:
     tb_logdir: str | None = None
     profile_dir: str | None = None
     fake_devices: int = 0  # >0: force CPU with N virtual devices (tests/dev)
+    # 1: apply the async-collective / latency-hiding libtpu flag set before
+    # backend init (parallel/overlap.py XLA_OVERLAP_FLAGS) — the compiler-
+    # side half of the ICI overlap layer; echoed by benches like BENCH_MODE
+    xla_overlap: int = 0
 
     # -- CLI --------------------------------------------------------------
 
@@ -103,7 +107,14 @@ class RunConfig:
     # -- environment application ------------------------------------------
 
     def apply_platform(self) -> None:
-        """Honor ``fake_devices`` BEFORE importing/initializing jax devices."""
+        """Honor ``fake_devices`` and ``xla_overlap`` BEFORE importing/
+        initializing jax devices."""
+        if self.xla_overlap:
+            from distributed_tensorflow_guide_tpu.parallel.overlap import (
+                apply_xla_overlap_flags,
+            )
+
+            apply_xla_overlap_flags(True)
         if self.fake_devices:
             import os
 

@@ -58,6 +58,25 @@ DEFAULT_CE_CHUNK = 8192
 # MXU tile column block up to half the GPT-2 vocab.
 CE_CHUNK_CANDIDATES = (1024, 2048, 4096, 8192, 16384, 32768)
 
+# --- bucketed DP all-reduce (parallel/overlap.py) --------------------------
+# Same table, same platform keying, same CPU defaults-only contract — the
+# tuning axis is the gradient BUCKET byte budget of the overlapped
+# data-parallel backward. The key reuses _key with (b=world, h=0,
+# s=param MiB, d=0); entries store {"bucket_bytes": x}.
+BUCKET_KERNEL = "dp_bucket"
+
+# Tested static fallback: 4 MiB per bucket. Big enough that each bucket's
+# ring all-reduce amortizes its latency on ICI, small enough that the
+# first reduction launches well before the backward finishes (PyTorch
+# DDP's default is 25 MB against NCCL launch overheads; ICI collective
+# launch is far cheaper, so the sweet spot sits lower — the sweep decides
+# per model/world on chip).
+DEFAULT_BUCKET_BYTES = 4 * 1024 * 1024
+
+# Sweep grid for bench_comm_overlap --tune: 1 MiB (fine-grained, maximum
+# overlap surface) up to 32 MiB (few launches, near-monolithic).
+BUCKET_BYTES_CANDIDATES = tuple((1 << 20) * m for m in (1, 2, 4, 8, 16, 32))
+
 LANE = 128  # TPU lane width; block edges must be sublane (8) multiples
 
 # Block-edge candidates for the sweep, filtered per shape by divisibility
@@ -352,6 +371,130 @@ def ensure_ce_tuned(*, n: int, d: int, v: int, dtype, iters: int = 10,
         detail["failed"] = failed
     ce_record(n=n, d=d, v=v, dtype=dtype, chunk=best, detail=detail,
               platform=plat)
+    return best
+
+
+# --------------------------------------------------------------------------
+# DP gradient-bucket table (parallel/overlap.py call sites)
+# --------------------------------------------------------------------------
+
+
+def bucket_candidates(param_bytes: int) -> list[int]:
+    """The sweep grid for one gradient-tree size: budgets that actually
+    bucket (strictly smaller than the tree — at budget >= param_bytes the
+    partition degenerates to the single monolithic all-reduce the
+    overlap-off path already covers)."""
+    return [c for c in BUCKET_BYTES_CANDIDATES if c < param_bytes]
+
+
+def _param_mib(param_bytes: int) -> int:
+    """MiB-granular size key: bucket winners are a property of the
+    gradient-tree SCALE, not its exact byte count — nearby models (a layer
+    added, a head resized) should share an entry instead of re-sweeping."""
+    return max(1, round(param_bytes / (1 << 20)))
+
+
+def bucket_lookup(*, param_bytes: int, world: int, dtype,
+                  platform: str | None = None) -> int | None:
+    """Tuned bucket bytes for the key, or None. Exact-world entry first,
+    then the world-generic one the sweep also records."""
+    plat = _platform(platform)
+    _maybe_load(plat)
+    dt = _dtype_name(dtype)
+    mib = _param_mib(param_bytes)
+    for key in (_key(BUCKET_KERNEL, world, 0, mib, 0, dt, False, plat),
+                _key(BUCKET_KERNEL, 0, 0, mib, 0, dt, False, plat)):
+        ent = _mem.get(key)
+        if ent and int(ent.get("bucket_bytes", 0)) > 0:
+            return int(ent["bucket_bytes"])
+    return None
+
+
+def bucket_bytes_for(*, param_bytes: int, world: int, dtype,
+                     platform: str | None = None) -> int:
+    """The bucket budget an overlapped-DP call site should use: the tuned
+    entry when one exists, else ``DEFAULT_BUCKET_BYTES``. Never sweeps,
+    never writes — safe at trace time on any platform; on CPU the table is
+    never even read (``_maybe_load`` hermeticity contract)."""
+    hit = bucket_lookup(param_bytes=param_bytes, world=world, dtype=dtype,
+                        platform=platform)
+    return hit if hit is not None else DEFAULT_BUCKET_BYTES
+
+
+def bucket_record(*, param_bytes: int, world: int, dtype,
+                  bucket_bytes: int, detail: dict | None = None,
+                  platform: str | None = None,
+                  generalize: bool = True) -> None:
+    """Write one bucket entry (exact-world key + the world-generic key)
+    and persist. Refused on CPU — same defaults-only contract as
+    :func:`record`."""
+    plat = _platform(platform)
+    if plat == "cpu":
+        raise RuntimeError(
+            "autotune.bucket_record refused on the CPU platform: tier-1 CI "
+            "is a defaults-only path (no table writes, no sweeps) so its "
+            "traced programs never depend on ambient tuning state")
+    bucket_bytes = int(bucket_bytes)
+    if bucket_bytes < 1:
+        raise ValueError(f"bucket_bytes {bucket_bytes} invalid (need >= 1)")
+    _maybe_load(plat)
+    dt = _dtype_name(dtype)
+    mib = _param_mib(param_bytes)
+    ent: dict = {"bucket_bytes": bucket_bytes}
+    if detail:
+        ent["detail"] = detail
+    with _lock:
+        _mem[_key(BUCKET_KERNEL, world, 0, mib, 0, dt, False, plat)] = ent
+        if generalize:
+            _mem[_key(BUCKET_KERNEL, 0, 0, mib, 0, dt, False, plat)] = (
+                dict(ent))
+        _persist_locked()
+
+
+def ensure_bucket_tuned(*, param_bytes: int, world: int, dtype,
+                        measure: Callable[[int], float],
+                        platform: str | None = None) -> int:
+    """Tuned bucket budget for the key — from the table when present (no
+    re-sweep), else sweep-and-record. ``measure(bucket_bytes) ->
+    secs_per_step`` is REQUIRED (unlike the CE sweep there is no canonical
+    standalone workload: the right bucket is a property of the caller's
+    model + mesh, so the bench times its own overlapped step per
+    candidate — bench_comm_overlap.py --tune). Refused on CPU."""
+    hit = bucket_lookup(param_bytes=param_bytes, world=world, dtype=dtype,
+                        platform=platform)
+    if hit is not None:
+        return hit
+    plat = _platform(platform)
+    if plat == "cpu":
+        raise RuntimeError(
+            "autotune bucket sweep refused on the CPU platform "
+            "(defaults-only path): interpret-mode timings are meaningless "
+            "and tier-1 CI must stay hermetic — use bucket_bytes_for() for "
+            "the fallback budget")
+    cands = bucket_candidates(param_bytes)
+    if not cands:
+        return bucket_bytes_for(param_bytes=param_bytes, world=world,
+                                dtype=dtype, platform=plat)
+    timed: dict[int, float] = {}
+    failed: list[dict] = []
+    for bb in cands:
+        try:
+            timed[bb] = float(measure(bb))
+        except Exception as e:  # noqa: BLE001 - record and move on
+            failed.append({"bucket_bytes": bb, "error": str(e)[:200]})
+    if not timed:
+        return bucket_bytes_for(param_bytes=param_bytes, world=world,
+                                dtype=dtype, platform=plat)
+    best = min(timed, key=timed.get)
+    detail = {
+        "param_bytes": int(param_bytes), "world": int(world),
+        "swept": [{"bucket_bytes": bb, "secs_per_step": round(t, 7)}
+                  for bb, t in sorted(timed.items())],
+    }
+    if failed:
+        detail["failed"] = failed
+    bucket_record(param_bytes=param_bytes, world=world, dtype=dtype,
+                  bucket_bytes=best, detail=detail, platform=plat)
     return best
 
 

@@ -41,12 +41,31 @@ LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
 
 
 class DataParallel:
-    """Build compiled sync-DP train/eval steps over a mesh's ``data`` axis."""
+    """Build compiled sync-DP train/eval steps over a mesh's ``data`` axis.
 
-    def __init__(self, mesh: Mesh, axis: str = "data"):
+    ``overlap`` ("auto"|True|False, default off) routes the gradient
+    all-reduce through the bucketed backward path (parallel/overlap.py):
+    per-bucket ``custom_vjp`` boundary markers on the parameter tree emit
+    each bucket's pmean mid-backward — where XLA's latency-hiding
+    scheduler can hide it under the remaining backward compute — instead
+    of one monolithic pmean after the full gradient tree. Bitwise-
+    identical gradients (all-reduce is elementwise per leaf; pinned in
+    tests/test_overlap.py); ``auto`` resolves on only for TPU, so CPU
+    tier-1 traces stay byte-identical to the overlap-off program.
+    ``bucket_bytes`` overrides the autotune-table bucket budget.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str = "data", *,
+                 overlap="off", bucket_bytes: int | None = None):
+        from distributed_tensorflow_guide_tpu.parallel import (
+            overlap as overlap_mod,
+        )
+
         self.mesh = mesh
         self.axis = axis
         self.world = axis_sizes(mesh)[axis]
+        self.overlap = overlap_mod.resolve_overlap(overlap)
+        self.bucket_bytes = bucket_bytes
 
     # ---- data placement ----------------------------------------------------
     def shard_batch(self, batch: Any) -> Any:
@@ -196,6 +215,21 @@ class DataParallel:
     def _pmean_metrics(self, mets: dict) -> dict:
         return {k: cc.pmean(v, self.axis) for k, v in mets.items()}
 
+    def _grad_loss_fn(self, loss_fn):
+        """The loss the backward differentiates: with overlap on, params
+        are wrapped in per-bucket sync markers so gradients come out
+        already pmean-ed (the call sites then skip the monolithic pmean);
+        with overlap off it is ``loss_fn`` itself — the identical object,
+        so the traced program cannot drift byte-wise."""
+        if not self.overlap:
+            return loss_fn
+        from distributed_tensorflow_guide_tpu.parallel import (
+            overlap as overlap_mod,
+        )
+
+        return overlap_mod.bucketed_loss_fn(
+            loss_fn, self.axis, self.bucket_bytes)
+
     def make_train_step(self, loss_fn: LossFn, *, donate: bool = True,
                         accum_steps: int = 1, steps_per_call: int = 1,
                         stacked_batch: bool = False,
@@ -215,11 +249,23 @@ class DataParallel:
         and still exactly one collective per step. The per-device shard
         length must divide by ``accum_steps``.
         """
+        if self.overlap and accum_steps > 1:
+            # pmean-per-microbatch then mean != mean then pmean bitwise
+            # (summation order), and per-microbatch collectives would
+            # multiply the wire traffic by accum_steps — the knobs solve
+            # different problems (memory vs exposure); pick one.
+            raise ValueError(
+                "overlap=True is incompatible with accum_steps > 1: the "
+                "bucketed backward reduces per microbatch backward, which "
+                "breaks the bitwise-identity contract with the single "
+                "post-accumulation pmean and multiplies collective traffic "
+                f"by accum_steps={accum_steps}")
+        grad_loss_fn = self._grad_loss_fn(loss_fn)
 
         def sm_step(state, batch):
             if accum_steps == 1:
                 (loss, mets), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
+                    grad_loss_fn, has_aux=True
                 )(state.params, batch)
             else:
                 shard_len = jax.tree.leaves(batch)[0].shape[0]
@@ -241,7 +287,8 @@ class DataParallel:
                 )
                 loss = jnp.mean(losses)
                 mets = jax.tree.map(jnp.mean, metas)
-            grads = cc.pmean(grads, self.axis)
+            if not self.overlap:  # bucketed bwd already reduced them
+                grads = cc.pmean(grads, self.axis)
             state = state.apply_gradients(grads=grads)
             return state, self._pmean_metrics({"loss": loss, **mets})
 
@@ -264,11 +311,14 @@ class DataParallel:
         race on PS-resident stats.
         """
 
+        grad_loss_fn = self._grad_loss_fn(loss_fn)
+
         def sm_step(state, batch):
             (loss, (mets, new_ms)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
+                grad_loss_fn, has_aux=True
             )(state.params, state.model_state, batch)
-            grads = cc.pmean(grads, self.axis)
+            if not self.overlap:  # bucketed bwd already reduced them
+                grads = cc.pmean(grads, self.axis)
             new_ms = cc.pmean(new_ms, self.axis)
             state = state.apply_gradients(grads=grads, model_state=new_ms)
             return state, self._pmean_metrics({"loss": loss, **mets})

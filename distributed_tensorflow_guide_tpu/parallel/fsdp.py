@@ -31,7 +31,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.core.compat import shard_map
 from distributed_tensorflow_guide_tpu.core.mesh import axis_sizes
+from distributed_tensorflow_guide_tpu.parallel import overlap as overlap_mod
 
 LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
 
@@ -62,11 +65,19 @@ class FSDP:
     """
 
     def __init__(self, mesh: Mesh, axis: str = "data",
-                 min_shard_size: int = 2 ** 14):
+                 min_shard_size: int = 2 ** 14, *, prefetch="off"):
         self.mesh = mesh
         self.axis = axis
         self.world = axis_sizes(mesh)[axis]
         self.min_shard_size = min_shard_size
+        # "auto"|True|False: the manual per-leaf gather/scatter schedule
+        # (parallel/overlap.py) instead of GSPMD's inferred one — each
+        # sharded leaf gets an explicit all-gather fwd / reduce-scatter
+        # bwd marker, one collective per leaf with no data dependence on
+        # the preceding layer's compute, so the async-collective scheduler
+        # can issue layer i+1's gather during layer i ("auto" = TPU only;
+        # CPU tier-1 keeps tracing the GSPMD program).
+        self.prefetch = overlap_mod.resolve_prefetch(prefetch)
 
     # -- layout ---------------------------------------------------------------
     def param_shardings(self, params_shape: Any) -> Any:
@@ -103,7 +114,13 @@ class FSDP:
                         *, donate: bool = True):
         """``(state, batch) -> (state, metrics)``. The batch is sharded over
         ``data`` like plain DP; params stay in their FSDP shards across
-        steps — only the transient gathered copies exist during compute."""
+        steps — only the transient gathered copies exist during compute.
+
+        With ``prefetch`` resolved on, the schedule is the manual one
+        (:meth:`_make_prefetch_step`) instead of GSPMD's."""
+        if self.prefetch:
+            return self._make_prefetch_step(loss_fn, state_shardings,
+                                            donate=donate)
         batch_sharding = NamedSharding(self.mesh, P(self.axis))
 
         def step(state, batch):
@@ -119,6 +136,46 @@ class FSDP:
             out_shardings=(state_shardings, NamedSharding(self.mesh, P())),
             donate_argnums=(0,) if donate else (),
         )
+
+    def _make_prefetch_step(self, loss_fn: LossFn, state_shardings: Any,
+                            *, donate: bool = True):
+        """The manual ZeRO-3 schedule (parallel/overlap.py markers) under
+        ``shard_map``: every sharded leaf all-gathers explicitly at the
+        parameter boundary (reduce-scatter of the MEAN gradient backward,
+        so grads land in shard layout and the optimizer update stays fully
+        sharded); replicated leaves keep a pmean backward. One collective
+        per leaf, none data-dependent on earlier layers' compute — the
+        per-layer schedule an async-collective scheduler can prefetch,
+        replacing whatever GSPMD inferred. Each device computes the loss
+        on its batch shard; reported metrics are pmean-ed, and the mean-
+        of-equal-local-means equals the GSPMD path's global mean (loss
+        parity pinned in tests/test_overlap.py — reduction orders differ,
+        so parity is close, not bitwise)."""
+        spec_tree = jax.tree.map(lambda s: s.spec, state_shardings)
+        param_shardings = state_shardings.params
+        axis = self.axis
+
+        def sm_step(state, batch):
+            def sharded_loss(shard_params, batch):
+                full = overlap_mod.gather_params(shard_params,
+                                                 param_shardings, axis)
+                return loss_fn(full, batch)
+
+            (loss, mets), grads = jax.value_and_grad(
+                sharded_loss, has_aux=True
+            )(state.params, batch)
+            state = state.apply_gradients(grads=grads)
+            return state, {k: cc.pmean(v, axis)
+                           for k, v in {"loss": loss, **mets}.items()}
+
+        sharded = shard_map(
+            sm_step,
+            mesh=self.mesh,
+            in_specs=(spec_tree, P(axis)),
+            out_specs=(spec_tree, P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
     def make_eval_step(self, metric_fn, state_shardings: Any):
         """``(state, batch) -> metrics`` — the no-grad half for the

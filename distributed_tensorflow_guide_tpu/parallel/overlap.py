@@ -1,0 +1,341 @@
+"""ICI communication overlap: bucketed DP all-reduce + manual FSDP schedule.
+
+After three rounds of host-overlap, kernel tuning and HBM dieting, the
+data-parallel backward still synchronized gradients with ONE monolithic
+``pmean`` over the whole gradient tree at the end of the backward pass
+(parallel/data_parallel.py), and FSDP left its all-gather/reduce-scatter
+schedule entirely to GSPMD defaults. Both leave the ICI idle exactly while
+the MXU is busiest. The canonical fixes this module ports to the TPU-native
+stack:
+
+* **Bucketed backward all-reduce** (PyTorch DDP's gradient bucketing, Li et
+  al., VLDB'20): partition the parameter tree into byte-budgeted buckets and
+  mark each bucket with a ``custom_vjp`` boundary — identity forward, pmean
+  backward. A bucket's reduction then *data-depends only on that bucket's
+  cotangents*, which autodiff produces mid-backward, so the collective is
+  emitted early in the backward HLO where XLA's latency-hiding scheduler can
+  run collective-start / remaining-backward-compute / collective-done
+  overlapped — instead of one giant all-reduce strictly after the full
+  gradient tree. Numerics are untouched: all-reduce is elementwise per
+  leaf, so any bucketing is bitwise-identical to the monolithic pmean
+  (pinned in tests/test_overlap.py). The bucket byte budget resolves
+  through the autotune table (ops/autotune.py — same persistence, same
+  platform keying, same CPU defaults-only hermeticity as the flash blocks
+  and CE chunks).
+
+* **Manual FSDP gather/scatter markers** (ZeRO-3's layerwise schedule,
+  Rajbhandari et al., SC'20): each sharded parameter leaf gets an explicit
+  all-gather forward / reduce-scatter backward ``custom_vjp`` pair (the
+  ZeRO conjugate of Megatron's f/g operators in collectives.py), replacing
+  GSPMD's inferred schedule with one collective per leaf that the scheduler
+  can prefetch: layer *i+1*'s gather has no data dependence on layer *i*'s
+  compute, so with async collectives enabled it is issued during it.
+  Replicated leaves (biases/norms) get identity-forward / pmean-backward.
+  Gradients leave the backward already in shard layout — the optimizer
+  update stays fully sharded (ZeRO-style), no full-tree gradient ever
+  materializes.
+
+* **The XLA async-collective knob**: the scheduler can only overlap
+  collectives it is allowed to run async. :func:`apply_xla_overlap_flags`
+  surfaces the relevant libtpu flags as ONE runtime knob (env
+  ``DTG_XLA_OVERLAP=1`` or ``RunConfig.xla_overlap``), applied before
+  backend init and echoed into bench JSON like ``BENCH_MODE`` is today.
+  (docs/performance.md records that the latency-hiding scheduler flag
+  measured as a no-op on a SINGLE chip — there is no ICI traffic to hide
+  there; multi-chip DP/FSDP is where this knob has work to do.)
+
+The instrument that judges all of this is benchmarks/bench_comm_overlap.py
+(exposed-comm fraction from an overlap on/off A/B against a no-collective
+compute floor) plus the ICI roofline models in benchmarks/common.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+from jax import lax
+
+import distributed_tensorflow_guide_tpu.collectives as cc
+
+__all__ = [
+    "resolve_overlap",
+    "resolve_prefetch",
+    "bucket_assignment",
+    "bucket_sync",
+    "pmean_buckets",
+    "bucketed_loss_fn",
+    "gather_shard",
+    "replicated_grad_sync",
+    "gather_params",
+    "XLA_OVERLAP_FLAGS",
+    "apply_xla_overlap_flags",
+    "xla_overlap_active",
+]
+
+
+# --------------------------------------------------------------------------
+# knob resolution (mirrors ops/fused_ce.resolve_fused_ce)
+# --------------------------------------------------------------------------
+
+
+def _resolve_tpu_auto(setting, knob: str, platform: str | None) -> bool:
+    """``"auto"|True|False`` (plus on/off spellings) -> bool; auto = ON only
+    on a TPU backend. Off on CPU keeps tier-1 CI tracing the byte-identical
+    legacy program — the same hermeticity posture as the autotune
+    defaults-only path. The battery pins both sides explicitly so the
+    on-chip capture adjudicates the policy, not the default."""
+    if isinstance(setting, bool):
+        return setting
+    if setting is None:
+        return False
+    s = str(setting).lower()
+    if s in ("on", "true", "1"):
+        return True
+    if s in ("off", "false", "0"):
+        return False
+    if s != "auto":
+        raise ValueError(
+            f"{knob} must be 'auto', on/True or off/False, got {setting!r}")
+    plat = platform if platform is not None else jax.default_backend()
+    return plat == "tpu"
+
+
+def resolve_overlap(setting, *, platform: str | None = None) -> bool:
+    """Resolve DataParallel's ``overlap`` knob (bucketed backward
+    all-reduce). ``auto`` = TPU only."""
+    return _resolve_tpu_auto(setting, "overlap", platform)
+
+
+def resolve_prefetch(setting, *, platform: str | None = None) -> bool:
+    """Resolve FSDP's ``prefetch`` knob (manual per-leaf gather/scatter
+    schedule). ``auto`` = TPU only."""
+    return _resolve_tpu_auto(setting, "fsdp prefetch", platform)
+
+
+# --------------------------------------------------------------------------
+# bucketed DP all-reduce
+# --------------------------------------------------------------------------
+
+
+def _leaf_bytes(leaf) -> int:
+    return int(leaf.size) * np.dtype(leaf.dtype).itemsize
+
+
+def bucket_assignment(leaves: Sequence[Any],
+                      bucket_bytes: int) -> list[list[int]]:
+    """Partition leaf indices into contiguous byte-budgeted buckets.
+
+    Deterministic in tree-flatten order (which groups a flax module's
+    leaves with their neighbors — the locality DDP's bucketing wants: a
+    bucket's reduction fires once the LAST of its members' cotangents is
+    ready, so members should become ready together). Every index appears
+    exactly once; a single leaf larger than the budget gets its own
+    bucket rather than being split (all-reduce is per-buffer anyway).
+    """
+    if bucket_bytes < 1:
+        raise ValueError(f"bucket_bytes must be >= 1, got {bucket_bytes}")
+    groups: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for i, leaf in enumerate(leaves):
+        nb = _leaf_bytes(leaf)
+        if cur and cur_bytes + nb > bucket_bytes:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def bucket_sync(leaves: tuple, axis: str):
+    """The DDP bucket boundary: identity forward, pmean backward.
+
+    Applied to one bucket's parameter leaves at the loss function's input,
+    so the bucket's gradient all-reduce appears in the backward exactly
+    where its cotangents are produced — mid-backward, overlappable —
+    instead of after the full gradient tree.
+    """
+    return leaves
+
+
+def _bucket_sync_fwd(leaves, axis):
+    return leaves, None
+
+
+def _bucket_sync_bwd(axis, _, cts):
+    # one fused collective per bucket; recorded in the ambient trace_comm
+    # like every collective the framework issues
+    return (cc.pmean(cts, axis),)
+
+
+bucket_sync.defvjp(_bucket_sync_fwd, _bucket_sync_bwd)
+
+
+def pmean_buckets(tree: Any, axis: str, bucket_bytes: int) -> Any:
+    """Wrap a parameter tree in per-bucket sync markers: values unchanged,
+    gradients come out pmean-ed over ``axis`` per bucket."""
+    leaves, treedef = jax.tree.flatten(tree)
+    out = list(leaves)
+    for group in bucket_assignment(leaves, bucket_bytes):
+        synced = bucket_sync(tuple(leaves[i] for i in group), axis)
+        for i, v in zip(group, synced):
+            out[i] = v
+    return jax.tree.unflatten(treedef, out)
+
+
+def bucketed_loss_fn(loss_fn: Callable, axis: str,
+                     bucket_bytes: int | None = None) -> Callable:
+    """Wrap ``loss_fn(params, *rest)`` so ``jax.grad`` of the result yields
+    gradients that are ALREADY pmean-ed over ``axis``, one bucket at a time
+    (call sites must not pmean again — that would double-reduce).
+
+    ``bucket_bytes=None`` resolves through the autotune table at trace time
+    (shapes are static): the tuned entry for (param bytes, world) when one
+    exists, else the tested default. On CPU the table is never read — the
+    defaults-only hermeticity contract.
+    """
+
+    def wrapped(params, *rest):
+        bb = bucket_bytes
+        if bb is None:
+            from distributed_tensorflow_guide_tpu.ops import autotune
+
+            p_leaves = jax.tree.leaves(params)
+            bb = autotune.bucket_bytes_for(
+                param_bytes=sum(_leaf_bytes(l) for l in p_leaves),
+                world=cc.axis_size(axis),
+                dtype=p_leaves[0].dtype if p_leaves else np.float32,
+            )
+        return loss_fn(pmean_buckets(params, axis, bb), *rest)
+
+    return wrapped
+
+
+# --------------------------------------------------------------------------
+# manual FSDP gather/scatter markers
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_shard(x, axis: str, dim: int):
+    """ZeRO-3 conjugate pair for one sharded leaf: all-gather the full
+    parameter forward, reduce-scatter the MEAN gradient back into shard
+    layout backward (so the optimizer update stays fully sharded)."""
+    return cc.all_gather(x, axis, tiled=True, gather_axis=dim)
+
+
+def _gather_shard_fwd(x, axis, dim):
+    return gather_shard(x, axis, dim), None
+
+
+def _gather_shard_bwd(axis, dim, _, ct):
+    n = cc.axis_size(axis)
+    return (cc.reduce_scatter(ct, axis, scatter_axis=dim) / n,)
+
+
+gather_shard.defvjp(_gather_shard_fwd, _gather_shard_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def replicated_grad_sync(x, axis: str):
+    """The replicated-leaf counterpart: identity forward, pmean backward
+    (biases/norms are already full on every device; only their gradients
+    need the data-axis mean)."""
+    return x
+
+
+def _replicated_fwd(x, axis):
+    return x, None
+
+
+def _replicated_bwd(axis, _, ct):
+    return (cc.pmean(ct, axis),)
+
+
+replicated_grad_sync.defvjp(_replicated_fwd, _replicated_bwd)
+
+
+def sharded_dim(spec, axis: str) -> int | None:
+    """The dimension a PartitionSpec splits over ``axis``, or None."""
+    for i, names in enumerate(tuple(spec)):
+        if names is None:
+            continue
+        if axis in (names if isinstance(names, tuple) else (names,)):
+            return i
+    return None
+
+
+def gather_params(shards: Any, shardings: Any, axis: str) -> Any:
+    """Reassemble full parameters from FSDP shards inside ``shard_map``,
+    leaf by leaf, with the ZeRO backward attached: sharded leaves
+    all-gather forward / reduce-scatter(mean) backward, replicated leaves
+    pass through with a pmean backward. One collective per leaf — the
+    per-layer schedule the latency-hiding scheduler can prefetch."""
+
+    def one(x, sh):
+        dim = sharded_dim(sh.spec, axis)
+        if dim is None:
+            return replicated_grad_sync(x, axis)
+        return gather_shard(x, axis, dim)
+
+    return jax.tree.map(one, shards, shardings)
+
+
+# --------------------------------------------------------------------------
+# the XLA async-collective / latency-hiding knob
+# --------------------------------------------------------------------------
+
+# The libtpu flag set that lets the scheduler actually run collectives
+# async under compute. Applied via LIBTPU_INIT_ARGS (the TPU channel —
+# docs/performance.md: tpu-scoped flags are unknown to this build's
+# XLA_FLAGS parser). The latency-hiding scheduler flag itself measured as
+# a no-op on a single chip (no ICI traffic to hide — "Knobs that did NOT
+# pay"); it rides along here because multi-chip DP/FSDP is its workload.
+XLA_OVERLAP_FLAGS: tuple[str, ...] = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+
+
+def apply_xla_overlap_flags(enable: bool | None = None) -> bool:
+    """Append the async-collective flag set to ``LIBTPU_INIT_ARGS`` (before
+    backend init — call it next to ``device_setup``). ``enable=None`` reads
+    the ``DTG_XLA_OVERLAP`` env knob. Idempotent: flags already present
+    (either spelling) are not duplicated. Returns whether the knob is
+    active, which benches echo into their JSON line like ``BENCH_MODE``.
+    """
+    if enable is None:
+        enable = os.environ.get(
+            "DTG_XLA_OVERLAP", "0").lower() in ("1", "true", "on")
+    if not enable:
+        return False
+    cur = os.environ.get("LIBTPU_INIT_ARGS", "")
+    # whole-token name match, not substring: ..._fusion must still be
+    # appended when only ..._fusion_fuse_all_gather is already present
+    present = {t.split("=", 1)[0] for t in cur.split()}
+    missing = [f for f in XLA_OVERLAP_FLAGS
+               if f.split("=", 1)[0] not in present]
+    if missing:
+        os.environ["LIBTPU_INIT_ARGS"] = " ".join([cur, *missing]).strip()
+    os.environ["DTG_XLA_OVERLAP"] = "1"
+    return True
+
+
+def xla_overlap_active() -> bool:
+    """Whether the overlap flag set has been applied this process (the
+    value benches echo — a capture must record the compiler mode it ran
+    under)."""
+    return os.environ.get("DTG_XLA_OVERLAP", "0").lower() in (
+        "1", "true", "on")

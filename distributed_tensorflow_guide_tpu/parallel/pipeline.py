@@ -687,6 +687,27 @@ class PipelinedLM:
             "tp": self.tp,
         }
 
+    def ppermute_bytes_per_step(self, microbatch_size: int) -> float:
+        """Closed-form per-device ICI ``ppermute`` traffic of ONE train
+        step: every microbatch's activation crosses each of the P−1
+        stage boundaries once forward and its gradient once backward, so
+        the ring-averaged per-device bytes are
+
+            2 · M · (mb · S · d_model · itemsize) · (P − 1) / P
+
+        — the pipeline leg of the interconnect roofline
+        (``benchmarks/common.pipeline_ppermute_bytes`` is the same
+        formula; equality pinned in tests/test_overlap.py). Zero at
+        P = 1: a single stage hands nothing off."""
+        import numpy as np
+
+        act = (microbatch_size * self.cfg.max_len * self.cfg.d_model
+               * np.dtype(self.cfg.dtype).itemsize)
+        if self.n_stages <= 1:
+            return 0.0
+        return (2.0 * self.num_microbatches * act
+                * (self.n_stages - 1) / self.n_stages)
+
     def param_specs(self) -> dict:
         """Spec tree: stage stack sharded over pipe (and, when the mesh has
         a ``model`` axis, Megatron-TP over it per leaf; the LM-head kernel

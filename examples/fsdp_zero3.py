@@ -38,6 +38,13 @@ def main() -> None:
                     help="chunked fused cross-entropy for the LM loss "
                          "(ops/fused_ce.py; 'auto' = on for TPU + "
                          "chunkable vocab)")
+    ap.add_argument("--fsdp-prefetch", choices=["auto", "on", "off"],
+                    default="auto",
+                    help="manual per-leaf gather/scatter schedule "
+                         "(parallel/overlap.py: explicit all-gather fwd / "
+                         "reduce-scatter bwd per leaf, prefetchable by the "
+                         "async-collective scheduler; 'auto' = on for TPU, "
+                         "off keeps GSPMD's inferred schedule)")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -81,7 +88,7 @@ def main() -> None:
         causal=True, dtype=jnp.float32,
     )
     model = Transformer(cfg)
-    fsdp = FSDP(mesh, min_shard_size=2 ** 10)
+    fsdp = FSDP(mesh, min_shard_size=2 ** 10, prefetch=args.fsdp_prefetch)
     tokens0 = jnp.zeros((1, cfg.max_len), jnp.int32)
 
     def init_fn():
@@ -117,6 +124,7 @@ def main() -> None:
     emb = state.params["tok_emb"]["embedding"]
     shard_frac = emb.addressable_shards[0].data.size / emb.size
     print(f"done: loss {first:.3f} -> {last:.3f}, mesh={axis_sizes(mesh)}, "
+          f"prefetch={'on' if fsdp.prefetch else 'off'}, "
           f"embedding sharding={emb.sharding.spec}, "
           f"local shard = {shard_frac:.3f} of the full table")
     if args.steps >= 20:  # short demo runs may not have converged yet

@@ -47,6 +47,12 @@ def main() -> None:
     ap.add_argument("--prefetch-depth", type=int, default=2,
                     help="device-prefetch buffers: batch N+1 transfers to "
                          "the mesh while step N computes (data/prefetch.py)")
+    ap.add_argument("--overlap", choices=["auto", "on", "off"],
+                    default="auto",
+                    help="bucketed backward gradient all-reduce "
+                         "(parallel/overlap.py; 'auto' = on for TPU — "
+                         "bitwise-identical grads, collectives overlap "
+                         "the remaining backward)")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -97,7 +103,7 @@ def main() -> None:
     if args.global_batch % n_dev:
         raise SystemExit(f"--global-batch must divide by {n_dev} devices")
 
-    dp = DataParallel(mesh)
+    dp = DataParallel(mesh, overlap=args.overlap)
     model_cls = ResNet50 if args.model == "resnet50" else ResNet18ish
     model = model_cls(num_classes=args.num_classes, dtype=jnp.bfloat16)
 
@@ -160,7 +166,8 @@ def main() -> None:
         tail = (f"; held-out accuracy {eval_hook.latest['accuracy']:.4f} "
                 f"(loss {eval_hook.latest['loss']:.4f})")
     print(f"done: {loop.step} steps ({args.model}, {args.image_size}px) on "
-          f"{n_dev} device(s); dispatches: {loop.dispatch_stats.as_dict()}"
+          f"{n_dev} device(s); overlap={'on' if dp.overlap else 'off'}"
+          f"; dispatches: {loop.dispatch_stats.as_dict()}"
           f"; prefetch: {data.stats.as_dict()}{tail}")
 
 

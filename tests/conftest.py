@@ -49,3 +49,17 @@ def mesh8():
 @pytest.fixture()
 def rng():
     return jax.random.PRNGKey(0)
+
+
+@pytest.fixture()
+def isolated_autotune_table(tmp_path, monkeypatch):
+    """An empty in-memory autotune table redirected to a tmp file — nothing
+    leaks between tests or to the user cache. One definition (round 9) for
+    the fixtures test_autotune / test_fused_ce / test_overlap all declare
+    autouse wrappers around."""
+    from distributed_tensorflow_guide_tpu.ops import autotune
+
+    monkeypatch.setenv("DTG_AUTOTUNE_TABLE", str(tmp_path / "table.json"))
+    autotune.reset()
+    yield autotune
+    autotune.reset()

@@ -27,13 +27,11 @@ from distributed_tensorflow_guide_tpu.ops.flash_attention import (
 
 
 @pytest.fixture(autouse=True)
-def _isolated_table(tmp_path, monkeypatch):
-    """Every test gets an empty in-memory table and a tmp table file —
-    nothing leaks between tests or to the user's cache."""
-    monkeypatch.setenv("DTG_AUTOTUNE_TABLE", str(tmp_path / "table.json"))
-    autotune.reset()
+def _isolated_table(isolated_autotune_table):
+    """Shared isolation (tests/conftest.py): every test gets an empty
+    in-memory table and a tmp table file — nothing leaks between tests or
+    to the user's cache."""
     yield
-    autotune.reset()
 
 
 SHAPE = dict(b=1, h=1, s=256, d=64)

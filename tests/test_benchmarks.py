@@ -42,6 +42,24 @@ def test_bench_smoke(script, args):
     assert result["value"] > 0
 
 
+# ---- run_battery empty-artifact guard (ADVICE round 5) ----------------------
+# A zero-byte battery_*.jsonl got committed as if it were capture evidence;
+# run_battery now refuses to create a record-free artifact.
+
+
+def test_run_battery_refuses_empty_artifact(tmp_path, monkeypatch):
+    from benchmarks import run_battery
+
+    out = tmp_path / "battery_empty.jsonl"
+    monkeypatch.setattr(run_battery, "BATTERY", [])
+    monkeypatch.setattr(sys, "argv",
+                        ["run_battery.py", "--out", str(out)])
+    with pytest.raises(SystemExit) as e:
+        run_battery.main()
+    assert "empty" in str(e.value)
+    assert not out.exists()
+
+
 # ---- bench.py orchestrator (round-2 hardening) ------------------------------
 # The driver's round-1 capture died on a hung/unavailable axon backend
 # (BENCH_r01.json rc=1). bench.py now probes the backend in a child process

@@ -53,7 +53,7 @@ def test_resnet_imagenet_dp_example():
         [sys.executable, str(REPO / "examples" / "resnet_imagenet_dp.py"),
          "--fake-devices", "8", "--steps", "6", "--model", "small",
          "--image-size", "32", "--global-batch", "32", "--num-classes", "10",
-         "--eval-batches", "2", "--log-every", "0"],
+         "--eval-batches", "2", "--log-every", "0", "--overlap", "on"],
         capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
     )
     assert r.returncode == 0, r.stdout + r.stderr
@@ -97,11 +97,13 @@ def test_fsdp_zero3_example():
     env.pop("XLA_FLAGS", None)
     r = subprocess.run(
         [sys.executable, str(REPO / "examples" / "fsdp_zero3.py"),
-         "--fake-devices", "8", "--steps", "12", "--global-batch", "8"],
+         "--fake-devices", "8", "--steps", "12", "--global-batch", "8",
+         "--fsdp-prefetch", "on"],
         capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "local shard = 0.125" in r.stdout, r.stdout
+    assert "prefetch=on" in r.stdout, r.stdout
 
 
 def test_bert_trains_from_labeled_text(tmp_path):

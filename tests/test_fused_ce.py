@@ -16,7 +16,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.extend import core as jex_core
 from jax.sharding import PartitionSpec as P
 
 from distributed_tensorflow_guide_tpu.core import precision
@@ -24,16 +23,16 @@ from distributed_tensorflow_guide_tpu.core.compat import shard_map
 from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
 from distributed_tensorflow_guide_tpu.ops import autotune
 from distributed_tensorflow_guide_tpu.ops import fused_ce as fce
+from tests.pin_utils import (
+    max_f32_elems_with_vocab_dim as _max_f32_elems_with_vocab_dim,
+)
 
 
 @pytest.fixture(autouse=True)
-def _isolated_table(tmp_path, monkeypatch):
-    """Same isolation as tests/test_autotune.py: empty in-memory table,
-    tmp table file — nothing leaks between tests or to the user cache."""
-    monkeypatch.setenv("DTG_AUTOTUNE_TABLE", str(tmp_path / "table.json"))
-    autotune.reset()
+def _isolated_table(isolated_autotune_table):
+    """Shared isolation (tests/conftest.py): empty in-memory table, tmp
+    table file — nothing leaks between tests or to the user cache."""
     yield
-    autotune.reset()
 
 
 def _case(n=24, d=16, v=50, seed=0, dtype=jnp.float32):
@@ -146,29 +145,7 @@ def test_fused_rejects_bad_args():
         fce.fused_cross_entropy(x, kernel.T, targets, chunk=8)
 
 
-# ---- the no-full-logits pin -------------------------------------------------
-
-
-def _max_f32_elems_with_vocab_dim(jaxpr, n, v):
-    """Largest f32 intermediate of shape (..., V) with >= n rows, walked
-    through every sub-jaxpr (scan/pjit/custom_vjp bodies included)."""
-    if isinstance(jaxpr, jex_core.ClosedJaxpr):
-        jaxpr = jaxpr.jaxpr
-    worst = 0
-    for eqn in jaxpr.eqns:
-        for var in eqn.outvars:
-            aval = var.aval
-            shape = getattr(aval, "shape", ())
-            if (getattr(aval, "dtype", None) == jnp.float32
-                    and len(shape) >= 2 and shape[-1] == v
-                    and int(np.prod(shape[:-1])) >= n):
-                worst = max(worst, int(np.prod(shape)))
-        for p in eqn.params.values():
-            for sub in (p if isinstance(p, (tuple, list)) else (p,)):
-                if isinstance(sub, (jex_core.Jaxpr, jex_core.ClosedJaxpr)):
-                    worst = max(
-                        worst, _max_f32_elems_with_vocab_dim(sub, n, v))
-    return worst
+# ---- the no-full-logits pin (walker shared via tests/pin_utils.py) ----------
 
 
 def test_fused_bwd_never_materializes_full_logits():

@@ -1,0 +1,503 @@
+"""ICI overlap layer (parallel/overlap.py + the DataParallel/FSDP knobs).
+
+The load-bearing pins the round-9 issue names:
+
+* bucketed/overlapped DP gradients are BITWISE-equal to the monolithic
+  ``pmean`` path — all-reduce is elementwise per leaf, so bucketing must
+  not move a single bit — at every bucket size the autotune sweep can
+  pick (and finer ones);
+* ``overlap="auto"`` resolves OFF on CPU and the traced program is
+  byte-identical to today's (tier-1 hermeticity — the same posture as
+  fused_ce="auto");
+* the FSDP manual gather/scatter schedule (prefetch on) matches the
+  GSPMD schedule (prefetch off) on loss and params — an execution-layout
+  change, not a different algorithm;
+* the bucket table keeps the autotune contracts: roundtrip determinism,
+  no re-sweep, CPU defaults-only (no table I/O);
+* the interconnect roofline closed forms (benchmarks/common.py) match
+  their definitions and the PipelinedLM ppermute model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax.training import train_state
+from jax.sharding import PartitionSpec as P
+
+import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+from distributed_tensorflow_guide_tpu.models.mnist_cnn import (
+    MNISTCNN,
+    make_loss_fn,
+)
+from distributed_tensorflow_guide_tpu.ops import autotune
+from distributed_tensorflow_guide_tpu.parallel import overlap
+from distributed_tensorflow_guide_tpu.parallel.data_parallel import (
+    DataParallel,
+)
+from distributed_tensorflow_guide_tpu.parallel.fsdp import FSDP
+from tests.pin_utils import traced_text
+
+
+@pytest.fixture(autouse=True)
+def _isolated_table(isolated_autotune_table):
+    yield
+
+
+def _init_state(lr=0.1):
+    model = MNISTCNN()
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(lr))
+    return model, state
+
+
+def _batch(n=32, seed=3):
+    from distributed_tensorflow_guide_tpu.data.synthetic import (
+        synthetic_mnist,
+    )
+
+    return synthetic_mnist(n, seed=seed).take(1)[0]
+
+
+# ---- knob resolution --------------------------------------------------------
+
+
+def test_resolve_overlap_policy():
+    for resolve in (overlap.resolve_overlap, overlap.resolve_prefetch):
+        assert resolve(True) is True
+        assert resolve(False) is False
+        assert resolve("on") is True
+        assert resolve("off") is False
+        assert resolve(None) is False
+        # auto: off on cpu (tier-1 traces stay byte-identical), on on TPU
+        assert resolve("auto") is False
+        assert resolve("auto", platform="tpu") is True
+        with pytest.raises(ValueError, match="auto"):
+            resolve("maybe")
+
+
+# ---- bucket partitioning ----------------------------------------------------
+
+
+def test_bucket_assignment_covers_budget_and_determinism():
+    leaves = [np.zeros(n, np.float32) for n in (10, 20, 30, 1000, 5, 5)]
+    groups = overlap.bucket_assignment(leaves, bucket_bytes=128)
+    # every index exactly once, order preserved
+    flat = [i for g in groups for i in g]
+    assert flat == list(range(len(leaves)))
+    # budget respected except for single oversized leaves (index 3: 4000 B)
+    for g in groups:
+        nbytes = sum(leaves[i].nbytes for i in g)
+        assert nbytes <= 128 or len(g) == 1
+    assert [3] in groups  # the oversized leaf buckets alone
+    # deterministic
+    assert groups == overlap.bucket_assignment(leaves, bucket_bytes=128)
+    # one giant budget -> the monolithic single bucket
+    assert overlap.bucket_assignment(leaves, 1 << 30) == [
+        list(range(len(leaves)))]
+    with pytest.raises(ValueError, match="bucket_bytes"):
+        overlap.bucket_assignment(leaves, 0)
+
+
+# ---- the gradient-identity pin ----------------------------------------------
+
+
+def _params_after_one_step(dp, state, batch):
+    step = dp.make_train_step(make_loss_fn(MNISTCNN()), donate=False)
+    new_state, mets = step(dp.replicate(state), dp.shard_batch(batch))
+    return (jax.tree.map(np.asarray, new_state.params), float(mets["loss"]))
+
+
+def test_bucketed_grads_bitwise_equal_monolithic_every_sweep_candidate():
+    """The acceptance pin: for EVERY bucket size the autotune sweep can
+    pick for this model (plus finer/coarser ones the table could carry),
+    one overlapped step lands on bitwise-identical params to the
+    monolithic-pmean step — all-reduce is elementwise per leaf, so the
+    partition must not move a bit. SGD makes params linear in grads, so
+    bitwise-equal params == bitwise-equal grads."""
+    _, state = _init_state()
+    batch = _batch()
+    mesh = build_mesh(MeshSpec(data=-1))
+    ref_params, ref_loss = _params_after_one_step(
+        DataParallel(mesh), state, batch)
+
+    param_bytes = sum(l.size * np.dtype(l.dtype).itemsize
+                      for l in jax.tree.leaves(state.params))
+    sweep = autotune.bucket_candidates(param_bytes)
+    assert sweep, "model too small for any sweep candidate"
+    # finer than the sweep floor (many buckets) and coarser than the tree
+    # (single bucket == monolithic partition, still through the marker)
+    for bb in [4 << 10, 64 << 10, *sweep, 2 * param_bytes]:
+        dp = DataParallel(mesh, overlap=True, bucket_bytes=bb)
+        got_params, got_loss = _params_after_one_step(dp, state, batch)
+        assert got_loss == ref_loss, f"bucket_bytes={bb}"
+        for a, b in zip(jax.tree.leaves(got_params),
+                        jax.tree.leaves(ref_params), strict=True):
+            np.testing.assert_array_equal(a, b,
+                                          err_msg=f"bucket_bytes={bb}")
+
+
+def test_bucketed_resolves_budget_through_autotune_table():
+    """With no explicit bucket_bytes the budget comes from the table: a
+    seeded in-memory entry (cpu platform key — only tests can seed it)
+    redirects the partition, and the step still lands bitwise on the
+    monolithic result."""
+    _, state = _init_state()
+    batch = _batch()
+    mesh = build_mesh(MeshSpec(data=-1))
+    param_bytes = sum(l.size * np.dtype(l.dtype).itemsize
+                      for l in jax.tree.leaves(state.params))
+    autotune._mem[autotune._key(
+        autotune.BUCKET_KERNEL, 8, 0, autotune._param_mib(param_bytes), 0,
+        "float32", False, "cpu")] = {"bucket_bytes": 32 << 10}
+    assert autotune.bucket_lookup(param_bytes=param_bytes, world=8,
+                                  dtype=jnp.float32) == 32 << 10
+    ref_params, _ = _params_after_one_step(DataParallel(mesh), state, batch)
+    got_params, _ = _params_after_one_step(
+        DataParallel(mesh, overlap=True), state, batch)
+    for a, b in zip(jax.tree.leaves(got_params),
+                    jax.tree.leaves(ref_params), strict=True):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_overlap_with_stats_path_bitwise_equal():
+    """make_train_step_with_stats: grads bucket, the model-state pmean is
+    untouched — bitwise-identical params AND batch stats."""
+    from distributed_tensorflow_guide_tpu.models.resnet import (
+        ResNet18ish,
+        make_loss_fn as make_resnet_loss,
+    )
+    from distributed_tensorflow_guide_tpu.train.state import (
+        TrainStateWithStats,
+    )
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    model = ResNet18ish(num_classes=4)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 16, 16, 3)), train=False)
+    state = TrainStateWithStats.create(
+        apply_fn=model.apply, params=variables["params"],
+        tx=optax.sgd(0.1),
+        model_state={"batch_stats": variables["batch_stats"]})
+    rng = np.random.RandomState(0)
+    batch = {"image": rng.randn(16, 16, 16, 3).astype(np.float32),
+             "label": rng.randint(0, 4, 16).astype(np.int32)}
+
+    def run(dp):
+        step = dp.make_train_step_with_stats(make_resnet_loss(model),
+                                             donate=False)
+        st, _ = step(dp.replicate(state), dp.shard_batch(batch))
+        return jax.tree.map(np.asarray, (st.params, st.model_state))
+
+    ref = run(DataParallel(mesh))
+    got = run(DataParallel(mesh, overlap=True, bucket_bytes=64 << 10))
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(ref),
+                    strict=True):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_overlap_auto_cpu_trace_byte_identical():
+    """The hermeticity pin: overlap="auto" on CPU resolves off and the
+    traced train-step program is BYTE-identical to today's overlap=False
+    program (with overlap=True as the positive control proving the
+    instrument sees the bucketed program when it exists)."""
+    _, state = _init_state()
+    batch = _batch()
+    mesh = build_mesh(MeshSpec(data=-1))
+    loss_fn = make_loss_fn(MNISTCNN())
+
+    def trace_of(dp):
+        step = dp.make_train_step(loss_fn, donate=False)
+        return traced_text(step, dp.replicate(state), dp.shard_batch(batch))
+
+    auto = trace_of(DataParallel(mesh, overlap="auto"))
+    off = trace_of(DataParallel(mesh))
+    assert auto == off
+    on = trace_of(DataParallel(mesh, overlap=True, bucket_bytes=64 << 10))
+    assert on != off
+
+
+def test_overlap_rejects_accum_steps():
+    mesh = build_mesh(MeshSpec(data=-1))
+    dp = DataParallel(mesh, overlap=True)
+    with pytest.raises(ValueError, match="accum_steps"):
+        dp.make_train_step(make_loss_fn(MNISTCNN()), accum_steps=4)
+
+
+def test_bucketed_backward_emits_one_collective_per_bucket():
+    """Observability: the bucketed step's trace records one grad pmean per
+    bucket (+ the 2 metric pmeans), vs the monolithic path's single grad
+    pmean — the early-emission structure the scheduler overlaps."""
+    _, state = _init_state()
+    batch = _batch()
+    mesh = build_mesh(MeshSpec(data=-1))
+    loss_fn = make_loss_fn(MNISTCNN())
+    n_leaves = len(jax.tree.leaves(state.params))
+
+    def traced_pmeans(dp):
+        with cc.trace_comm() as rec:
+            step = dp.make_train_step(loss_fn, donate=False)
+            step.lower(dp.replicate(state), dp.shard_batch(batch))
+        return rec.calls["pmean[data]"]
+
+    mono = traced_pmeans(DataParallel(mesh))
+    # one-leaf-per-bucket budget: every leaf gets its own collective
+    fine = traced_pmeans(DataParallel(mesh, overlap=True, bucket_bytes=1))
+    # shard_map may trace the body once or twice; both counts allow it
+    # (mono = 1 grad-tree pmean + 2 metric pmeans)
+    assert mono in (3, 6)
+    assert fine in (n_leaves + 2, 2 * (n_leaves + 2))
+
+
+# ---- FSDP manual schedule ---------------------------------------------------
+
+
+def _fsdp_setup(prefetch, lr=0.1):
+    mesh = build_mesh(MeshSpec(data=-1))
+    model = MNISTCNN()
+    fsdp = FSDP(mesh, min_shard_size=2 ** 10, prefetch=prefetch)
+
+    def init_fn():
+        return model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))["params"]
+
+    params, shardings = fsdp.init_params(init_fn)
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=params,
+        tx=optax.sgd(lr, momentum=0.9))
+    st_sh = fsdp.state_shardings(state, shardings)
+    state = jax.device_put(state, st_sh)
+    step = fsdp.make_train_step(make_loss_fn(model), st_sh, donate=False)
+    return mesh, fsdp, state, step, st_sh
+
+
+def test_fsdp_prefetch_matches_gspmd_schedule():
+    """The loss-parity pin: the manual per-leaf gather/scatter schedule is
+    an execution-layout change, not a different algorithm — same losses,
+    same params as the GSPMD path over a training trajectory (reduction
+    orders differ, so close, not bitwise)."""
+    from distributed_tensorflow_guide_tpu.data.synthetic import (
+        synthetic_mnist,
+    )
+    from jax.sharding import NamedSharding
+
+    mesh, _, state_g, step_g, _ = _fsdp_setup(prefetch=False)
+    _, _, state_m, step_m, _ = _fsdp_setup(prefetch=True)
+    for b in synthetic_mnist(32, seed=7).take(4):
+        b = jax.device_put(b, NamedSharding(mesh, P("data")))
+        state_g, m_g = step_g(state_g, b)
+        state_m, m_m = step_m(state_m, b)
+        np.testing.assert_allclose(float(m_g["loss"]), float(m_m["loss"]),
+                                   rtol=1e-4)
+    for a, b_ in zip(jax.tree.leaves(state_g.params),
+                     jax.tree.leaves(state_m.params), strict=True):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_fsdp_prefetch_keeps_shards_and_emits_gather_scatter():
+    """Structure: params/moments stay in shard layout across the manual
+    step, and its trace records one all_gather per sharded leaf with the
+    matching reduce_scatter backward + pmean for the replicated leaves —
+    the explicit ZeRO-3 schedule GSPMD used to infer."""
+    from distributed_tensorflow_guide_tpu.data.synthetic import (
+        synthetic_mnist,
+    )
+    from jax.sharding import NamedSharding
+
+    mesh, fsdp, state, step, st_sh = _fsdp_setup(prefetch=True)
+    sharded = [l for l in jax.tree.leaves(state.params)
+               if "data" in tuple(s for s in l.sharding.spec if s)]
+    assert sharded, "no parameter leaf is sharded over data"
+    n_sharded = len(sharded)
+    n_leaves = len(jax.tree.leaves(state.params))
+
+    b = jax.device_put(synthetic_mnist(32, seed=1).take(1)[0],
+                       NamedSharding(mesh, P("data")))
+    with cc.trace_comm() as rec:
+        step2 = fsdp.make_train_step(make_loss_fn(MNISTCNN()), st_sh,
+                                     donate=False)
+        step2.lower(state, b)
+    # shard_map may trace once or twice; normalize by the gather count
+    per_trace = rec.calls["all_gather[data]"] // n_sharded
+    assert per_trace in (1, 2)
+    assert rec.calls["all_gather[data]"] == per_trace * n_sharded
+    assert rec.calls["reduce_scatter[data]"] == per_trace * n_sharded
+    # replicated leaves' grads + the loss/accuracy metric pmeans
+    assert rec.calls["pmean[data]"] == per_trace * (n_leaves - n_sharded + 2)
+
+    # and the step leaves the layout untouched: run it for real
+    state2, m = step(state, b)
+    assert np.isfinite(float(m["loss"]))
+    big = max(jax.tree.leaves(state2.params), key=lambda l: l.size)
+    assert "data" in tuple(s for s in big.sharding.spec if s)
+    assert big.addressable_shards[0].data.size == big.size // 8
+
+
+def test_fsdp_prefetch_auto_resolves_off_on_cpu():
+    mesh = build_mesh(MeshSpec(data=-1))
+    assert FSDP(mesh, prefetch="auto").prefetch is False
+    assert FSDP(mesh, prefetch="on").prefetch is True
+
+
+# ---- bucket autotune table --------------------------------------------------
+
+
+def test_bucket_table_roundtrip_no_resweep():
+    """Same key -> same budget, sweep runs once, persists across a
+    simulated restart; the world-generic entry serves other worlds."""
+    calls = []
+
+    def measure(bb):
+        calls.append(bb)
+        return 1.0 / bb  # favors the largest bucket
+
+    kw = dict(param_bytes=40 << 20, world=8, dtype=jnp.float32,
+              platform="tpu")
+    first = autotune.ensure_bucket_tuned(measure=measure, **kw)
+    assert first == 32 << 20  # largest candidate < param_bytes
+    n_swept = len(calls)
+    assert n_swept == len(autotune.bucket_candidates(40 << 20))
+
+    again = autotune.ensure_bucket_tuned(measure=measure, **kw)
+    assert again == first and len(calls) == n_swept  # no re-sweep
+
+    autotune.reset()  # "restart": reload from the persisted file
+    assert autotune.ensure_bucket_tuned(measure=measure, **kw) == first
+    assert len(calls) == n_swept
+    # the world-generic entry serves other mesh sizes without a sweep
+    assert autotune.bucket_bytes_for(param_bytes=40 << 20, world=16,
+                                     dtype=jnp.float32,
+                                     platform="tpu") == first
+    # a different param scale misses back to the tested default
+    assert autotune.bucket_bytes_for(param_bytes=400 << 20, world=8,
+                                     dtype=jnp.float32, platform="tpu"
+                                     ) == autotune.DEFAULT_BUCKET_BYTES
+    with pytest.raises(ValueError, match="invalid"):
+        autotune.bucket_record(param_bytes=40 << 20, world=8,
+                               dtype=jnp.float32, bucket_bytes=0,
+                               platform="tpu")
+
+
+def test_bucket_cpu_is_defaults_only_no_table_io():
+    """The tier-1 guard: on the cpu backend the bucket layer neither reads
+    nor writes the table and refuses to sweep — a stray host table must
+    not change what CI traces."""
+    import json
+    import os
+    from pathlib import Path
+
+    path = Path(os.environ["DTG_AUTOTUNE_TABLE"])
+    seeded = {autotune._key(autotune.BUCKET_KERNEL, 0, 0, 2, 0,
+                            "float32", False, "cpu"): {"bucket_bytes": 123}}
+    path.write_text(json.dumps(seeded))
+
+    got = autotune.bucket_bytes_for(param_bytes=2 << 20, world=8,
+                                    dtype=jnp.float32)
+    assert got == autotune.DEFAULT_BUCKET_BYTES  # file ignored on cpu
+    with pytest.raises(RuntimeError, match="defaults-only"):
+        autotune.bucket_record(param_bytes=2 << 20, world=8,
+                               dtype=jnp.float32, bucket_bytes=1 << 20)
+    with pytest.raises(RuntimeError, match="defaults-only"):
+        autotune.ensure_bucket_tuned(param_bytes=2 << 20, world=8,
+                                     dtype=jnp.float32,
+                                     measure=lambda bb: 0.0)
+    assert json.loads(path.read_text()) == seeded  # file untouched
+
+
+# ---- interconnect roofline closed forms -------------------------------------
+
+
+def test_ici_comm_byte_models():
+    from benchmarks.common import (
+        device_ici_peak,
+        dp_allreduce_bytes,
+        fsdp_comm_bytes,
+        ici_extras,
+        pipeline_ppermute_bytes,
+    )
+
+    # DP ring allreduce: 2 passes at (n-1)/n each; degenerate at world 1
+    assert dp_allreduce_bytes(100.0, 8) == 2.0 * 100.0 * 7 / 8
+    assert dp_allreduce_bytes(100.0, 1) == 0.0
+    # FSDP as scheduled here: gather fwd (held as residual through bwd —
+    # no re-gather) + reduce-scatter = 2 passes on the sharded bytes;
+    # replicated grads pay the plain allreduce
+    assert fsdp_comm_bytes(100.0, 8) == 2.0 * 100.0 * 7 / 8
+    assert fsdp_comm_bytes(100.0, 8, replicated_grad_bytes=10.0) == (
+        2.0 * 100.0 + 2.0 * 10.0) * 7 / 8
+    assert fsdp_comm_bytes(100.0, 1) == 0.0
+    # pipeline: 2 crossings per microbatch per boundary, ring-averaged
+    assert pipeline_ppermute_bytes(100.0, 4, 8) == 2.0 * 4 * 100.0 * 7 / 8
+    assert pipeline_ppermute_bytes(100.0, 4, 1) == 0.0
+    # extras: closed-form bytes always; wire rate only with a measured
+    # comm time; roofline frac only on real hardware (None here: CPU)
+    assert device_ici_peak() is None
+    ex = ici_extras(2e9, 0.5)
+    assert ex["comm_gb"] == 2.0 and ex["ici_gb_per_s"] == 4.0
+    assert "ici_roofline_frac" not in ex
+    assert "ici_gb_per_s" not in ici_extras(2e9, None)
+
+
+def test_pipeline_ppermute_model_matches_common():
+    from benchmarks.common import pipeline_ppermute_bytes
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        TransformerConfig,
+    )
+    from distributed_tensorflow_guide_tpu.parallel.pipeline import (
+        PipelinedLM,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=4, num_heads=2, d_model=16, d_ff=32,
+        max_len=8, causal=True, dtype=jnp.float32)
+    mesh = build_mesh(MeshSpec(data=2, pipe=4))
+    pp = PipelinedLM(mesh, cfg, num_microbatches=4)
+    act = 2 * cfg.max_len * cfg.d_model * 4  # mb=2, f32
+    assert pp.ppermute_bytes_per_step(2) == pipeline_ppermute_bytes(
+        act, 4, 4)
+    # single stage: nothing to hand off
+    pp1 = PipelinedLM(build_mesh(MeshSpec(data=8, pipe=1)), cfg,
+                      num_microbatches=4)
+    assert pp1.ppermute_bytes_per_step(2) == 0.0
+
+
+# ---- the XLA flags knob -----------------------------------------------------
+
+
+def test_xla_overlap_flags_knob(monkeypatch):
+    monkeypatch.delenv("DTG_XLA_OVERLAP", raising=False)
+    monkeypatch.setenv("LIBTPU_INIT_ARGS",
+                       "--xla_tpu_enable_async_collective_fusion=false")
+    assert overlap.apply_xla_overlap_flags(False) is False
+    assert overlap.xla_overlap_active() is False
+
+    assert overlap.apply_xla_overlap_flags(True) is True
+    import os as _os
+
+    libtpu = _os.environ["LIBTPU_INIT_ARGS"]
+    # every flag present by name, the preexisting spelling NOT duplicated
+    for f in overlap.XLA_OVERLAP_FLAGS:
+        assert f.split("=", 1)[0] in libtpu
+    assert libtpu.count("--xla_tpu_enable_async_collective_fusion=") == 1
+    assert overlap.xla_overlap_active() is True
+    # idempotent
+    before = _os.environ["LIBTPU_INIT_ARGS"]
+    overlap.apply_xla_overlap_flags(True)
+    assert _os.environ["LIBTPU_INIT_ARGS"] == before
+    # env-driven resolution (enable=None)
+    monkeypatch.setenv("DTG_XLA_OVERLAP", "0")
+    assert overlap.apply_xla_overlap_flags(None) is False
+
+
+def test_runconfig_xla_overlap_roundtrips():
+    from distributed_tensorflow_guide_tpu.core.config import RunConfig
+
+    cfg = RunConfig.from_argv(["--xla-overlap", "1"])
+    assert cfg.xla_overlap == 1
+    assert RunConfig.from_dict(cfg.to_dict()).xla_overlap == 1
+    assert RunConfig().xla_overlap == 0
