@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Resilience bench: checkpoint overhead (sync vs async), recovery MTTR and
+goodput under a seeded fault storm.
+
+Three timed phases on one host-bound toy workload (a jit-ed update over a
+``--state-mb`` parameter vector — big enough that serialization costs real
+time, small enough to run anywhere):
+
+1. **floor** — no checkpointing: the per-step baseline.
+2. **sync saves** — ``Checkpointer.save`` blocks until durable+manifested
+   every ``--ckpt-every`` steps: the step pays the full serialization cost.
+3. **async saves** — ``save(async_=True)``: the step pays only the host
+   snapshot; durability settles at the next barrier.
+
+``save_overhead_frac_{sync,async}`` = (phase − floor) / floor. Then a
+**chaos phase**: ``run_with_recovery`` + AnomalySentinelHook + watchdog
+under ``testing/chaos.py FaultSchedule.random(--seed)`` (step exceptions,
+NaN batches, checkpoint truncation/corruption, iterator stalls), reporting
+``recovery_mttr_s`` (mean wall-clock from a fault to the first step after
+restore) and ``goodput_frac`` (steps that counted / steps executed,
+replays included).
+
+This bench is platform-independent by design — disk + host CPU are the
+hardware under test — so a CPU run produces real numbers (no skip JSON).
+``--async-save`` selects only the HEADLINE side; both sides are always
+measured, so battery rows differing in that one knob stay an A/B.
+"""
+
+import argparse
+import json  # noqa: F401  (kept for symmetry with sibling benches)
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.common import device_setup, report
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=80,
+                    help="steps per timed overhead phase")
+    ap.add_argument("--ckpt-every", type=int, default=10)
+    ap.add_argument("--state-mb", type=int, default=32,
+                    help="parameter-state size (MiB) — what a save costs")
+    ap.add_argument("--chaos-steps", type=int, default=60,
+                    help="target steps for the fault-storm phase")
+    ap.add_argument("--faults", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--stall-s", type=float, default=0.6)
+    ap.add_argument("--async-save", choices=["on", "off"], default="on",
+                    help="headline side of the sync/async A/B (both are "
+                         "always measured)")
+    ap.add_argument("--workdir", default="",
+                    help="checkpoint scratch dir (default: a tmp dir)")
+    ap.add_argument("--fake-devices", type=int, default=0)
+    ap.add_argument("--small", action="store_true",
+                    help="tiny liveness geometry (smoke suite)")
+    args = ap.parse_args()
+    if args.small:
+        args.steps = min(args.steps, 16)
+        args.chaos_steps = min(args.chaos_steps, 16)
+        args.state_mb = min(args.state_mb, 2)
+        args.ckpt_every = min(args.ckpt_every, 4)
+        args.faults = min(args.faults, 2)
+        args.stall_s = min(args.stall_s, 0.4)
+
+    device_setup(args.fake_devices)
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_guide_tpu.testing.chaos import FaultSchedule
+    from distributed_tensorflow_guide_tpu.train.anomaly import (
+        AnomalySentinelHook,
+    )
+    from distributed_tensorflow_guide_tpu.train.checkpoint import (
+        Checkpointer,
+        CheckpointHook,
+    )
+    from distributed_tensorflow_guide_tpu.train.elastic import (
+        run_with_recovery,
+    )
+    from distributed_tensorflow_guide_tpu.train.hooks import (
+        BaseHook,
+        StopAtStepHook,
+    )
+    from distributed_tensorflow_guide_tpu.train.loop import TrainLoop
+
+    n = args.state_mb * (1 << 20) // 4
+
+    @jax.jit
+    def step_fn(state, batch):
+        w = state["w"]
+        w = w - 0.001 * (0.5 * w + batch)
+        return {"w": w}, {"loss": jnp.sum(w[:1024] ** 2)}
+
+    def init_state():
+        return {"w": jnp.zeros((n,), jnp.float32)}
+
+    def make_data(start):
+        return (np.float32(1.0 + (s % 7)) for s in range(start, 10 ** 9))
+
+    # warmup compile outside every timed phase
+    state, _ = step_fn(init_state(), np.float32(1.0))
+    jax.block_until_ready(state["w"])
+
+    def timed_phase(ckpt_dir, async_=None):
+        hooks = [StopAtStepHook(args.steps)]
+        ckpt = None
+        if async_ is not None:
+            ckpt = Checkpointer(ckpt_dir, max_to_keep=2)
+            hooks.append(CheckpointHook(ckpt, args.ckpt_every,
+                                        async_save=async_))
+        loop = TrainLoop(step_fn, init_state(), make_data(0), hooks=hooks)
+        t0 = time.perf_counter()
+        final = loop.run()
+        jax.block_until_ready(final["w"])
+        secs = time.perf_counter() - t0
+        if ckpt is not None:
+            ckpt.close()
+        return secs / args.steps
+
+    scratch = args.workdir or tempfile.mkdtemp(prefix="dtg_resilience_")
+    scratch = Path(scratch)
+    t_floor = timed_phase(None)
+    t_sync = timed_phase(scratch / "sync", async_=False)
+    t_async = timed_phase(scratch / "async", async_=True)
+    frac_sync = (t_sync - t_floor) / t_floor
+    frac_async = (t_async - t_floor) / t_floor
+
+    # ---- chaos phase: MTTR + goodput under a seeded storm ------------------
+    sched = FaultSchedule.random(
+        args.seed, max_position=max(args.chaos_steps - 2, 3),
+        n_faults=args.faults, min_position=1, stall_s=args.stall_s,
+    )
+    trace: list[tuple[float, int]] = []
+    executed = [0]  # every step-fn completion — including ones the
+    # sentinel then condemns, which pay dispatch cost but never reach a
+    # hook (the goodput denominator must count them)
+
+    def counted_step(state, batch):
+        out = step_fn(state, batch)
+        executed[0] += 1
+        return out
+
+    class TraceHook(BaseHook):
+        def after_step(self, step, metrics):
+            trace.append((time.perf_counter(), step))
+
+    ckpt = Checkpointer(scratch / "chaos", max_to_keep=3)
+    t0 = time.perf_counter()
+    run_with_recovery(
+        sched.wrap_step(counted_step), init_state(),
+        sched.inject_data(make_data, checkpoint_dir=scratch / "chaos"),
+        ckpt,
+        hooks=[StopAtStepHook(args.chaos_steps),
+               AnomalySentinelHook(budget=args.faults + 1), TraceHook()],
+        checkpoint_every=args.ckpt_every,
+        max_restarts=2 * args.faults + 2,
+        async_save=args.async_save == "on",
+        data_deadline_s=max(args.stall_s / 2, 10 * t_floor),
+    )
+    chaos_wall = time.perf_counter() - t0
+    ckpt.close()
+
+    # a restart shows as the step sequence jumping backwards; MTTR is the
+    # wall gap from the last step before the fault to the first step after
+    # the restore (restore + replay-dispatch latency included)
+    gaps = [trace[i + 1][0] - trace[i][0]
+            for i in range(len(trace) - 1)
+            if trace[i + 1][1] <= trace[i][1]]
+    mttr = sum(gaps) / len(gaps) if gaps else 0.0
+    goodput = args.chaos_steps / max(executed[0], 1)
+
+    report(
+        "resilience",
+        1.0 / (t_async if args.async_save == "on" else t_sync),
+        "steps/sec",
+        baseline=1.0 / t_sync,
+        async_save=args.async_save,
+        step_s_floor=round(t_floor, 5),
+        step_s_sync=round(t_sync, 5),
+        step_s_async=round(t_async, 5),
+        save_overhead_frac=round(
+            frac_async if args.async_save == "on" else frac_sync, 4),
+        save_overhead_frac_sync=round(frac_sync, 4),
+        save_overhead_frac_async=round(frac_async, 4),
+        recovery_mttr_s=round(mttr, 4),
+        goodput_frac=round(goodput, 4),
+        chaos_wall_s=round(chaos_wall, 2),
+        chaos_restarts=len(gaps),
+        chaos_faults=[f"{f.kind}@{f.position}" for f in sched.fired],
+        state_mb=args.state_mb,
+        ckpt_every=args.ckpt_every,
+        seed=args.seed,
+    )
+
+
+if __name__ == "__main__":
+    main()

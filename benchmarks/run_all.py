@@ -28,6 +28,7 @@ BENCHES = [
     "bench_flash_kernel.py",  # kernel-only flash/carry roofline fractions
     "bench_fused_ce.py",      # LM-head loss alone: naive vs chunked fused CE
     "bench_comm_overlap.py",  # ICI overlap: exposed-comm fraction A/B
+    "bench_resilience.py",    # checkpoint overhead + MTTR/goodput (CPU-real)
 ]
 
 # Tiny fake-device configs, small enough for CPU (also used by
@@ -99,6 +100,11 @@ SMOKE = {
         # and the comm_bytes/exposed_comm_frac keys are emitted; timings
         # meaningless (off-TPU skip-JSON contract covers real mode)
         ["--fake-devices", "8", "--small"],
+    "bench_resilience.py":
+        # NOT a liveness stub: this bench is platform-independent (disk +
+        # host CPU are the hardware under test), so even the smoke's small
+        # geometry produces real save_overhead/MTTR/goodput numbers
+        ["--small", "--seed", "0"],
 }
 
 

@@ -138,6 +138,14 @@ BATTERY: list[tuple[str, list[str], int]] = [
     # continuity pin, same rule as the gpt2_pp rows: SwitchLM's
     # fused_ce="auto" would otherwise flip this row's loss path on TPU
     ("moe_lm", ["benchmarks/bench_moe_lm.py", "--fused-ce", "off"], 1800),
+    # resilience A/B (round 10): argv-identical except the one knob — the
+    # headline side of the sync/async save pair (both sides are measured in
+    # each row; the knob only selects which one is `value`). Platform-
+    # independent: these rows produce real numbers even off-TPU.
+    ("resilience_overhead",
+     ["benchmarks/bench_resilience.py", "--async-save", "on"], 1200),
+    ("resilience_overhead_sync",
+     ["benchmarks/bench_resilience.py", "--async-save", "off"], 1200),
     ("native_input", ["benchmarks/bench_native_input.py"], 1200),
     ("resnet_native_input",
      ["benchmarks/bench_resnet_native_input.py"], 1800),

@@ -59,6 +59,44 @@ class DistConfig:
 _initialized = False
 
 
+def retry_with_backoff(
+    fn,
+    *,
+    attempts: int = 3,
+    base_delay_s: float = 1.0,
+    max_delay_s: float = 30.0,
+    retry_on: tuple[type[BaseException], ...] = (RuntimeError, OSError),
+    sleep=None,
+    what: str = "operation",
+):
+    """Call ``fn()`` up to ``attempts`` times with exponential backoff.
+
+    The coordinator handshake is the classic transient: process 0's
+    listener may come up seconds after the peers dial in (the reference's
+    run.sh had the same race and simply hung). Delay doubles per attempt
+    from ``base_delay_s`` up to ``max_delay_s`` — deterministic, no
+    jitter, so multi-process retries stay in lockstep with each other.
+    The last failure re-raises unchanged.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    import time
+
+    sleep = time.sleep if sleep is None else sleep
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt + 1 >= attempts:
+                raise
+            delay = min(base_delay_s * 2 ** attempt, max_delay_s)
+            log.warning(
+                "%s failed (attempt %d/%d): %s — retrying in %.1fs",
+                what, attempt + 1, attempts, e, delay,
+            )
+            sleep(delay)
+
+
 def ensure_platform_from_env(*, strict: bool = True) -> None:
     """Re-assert JAX_PLATFORMS / JAX_NUM_CPU_DEVICES from the environment.
 
@@ -188,7 +226,15 @@ def initialize(config: DistConfig | None = None) -> None:
         kwargs["num_processes"] = nproc
     if pid is not None:
         kwargs["process_id"] = pid
-    jax.distributed.initialize(**kwargs)
+    # The handshake is retried with backoff: a coordinator that boots a few
+    # seconds late (restarted chief, slow container) must not be fatal.
+    # DTG_INIT_RETRIES=1 restores the old fail-immediately behavior.
+    retry_with_backoff(
+        lambda: jax.distributed.initialize(**kwargs),
+        attempts=int(os.environ.get("DTG_INIT_RETRIES", "3")),
+        base_delay_s=float(os.environ.get("DTG_INIT_BACKOFF_S", "1.0")),
+        what="jax.distributed.initialize",
+    )
     _initialized = True
     from distributed_tensorflow_guide_tpu.core.mesh import num_slices
 

@@ -57,6 +57,7 @@ class PrefetchStats:
     host_wait_s: float = 0.0
     put_s: float = 0.0
     peak_ahead: int = 0
+    max_host_wait_s: float = 0.0  # worst single upstream fetch (stall signal)
 
     def as_dict(self) -> dict:
         return {
@@ -64,6 +65,7 @@ class PrefetchStats:
             "prefetch_host_wait_s": round(self.host_wait_s, 4),
             "prefetch_put_s": round(self.put_s, 4),
             "prefetch_peak_ahead": self.peak_ahead,
+            "prefetch_max_host_wait_s": round(self.max_host_wait_s, 4),
         }
 
 
@@ -130,11 +132,16 @@ class DevicePrefetchIterator:
 
     def __init__(self, source: Iterable, *, sharding: Any = None,
                  depth: int = 2,
-                 put_fn: Callable[[Any], Any] | None = None):
+                 put_fn: Callable[[Any], Any] | None = None,
+                 max_host_wait_s: float | None = None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        if max_host_wait_s is not None and max_host_wait_s <= 0:
+            raise ValueError(
+                f"max_host_wait_s must be > 0, got {max_host_wait_s}")
         self._src = iter(source)
         self.depth = depth
+        self.max_host_wait_s = max_host_wait_s
         self.stats = PrefetchStats()
         if put_fn is not None:
             self._put = put_fn
@@ -157,9 +164,27 @@ class DevicePrefetchIterator:
                 self._exhausted = True
                 return
             t1 = time.perf_counter()
+            self.stats.host_wait_s += t1 - t0
+            self.stats.max_host_wait_s = max(self.stats.max_host_wait_s,
+                                             t1 - t0)
+            if (self.max_host_wait_s is not None
+                    and t1 - t0 > self.max_host_wait_s):
+                # fail-fast: a data stall becomes a recoverable error
+                # instead of silently eating the run's wall-clock budget
+                # (the in-flight-hang half is the TrainLoop watchdog's job —
+                # this deadline catches slow-but-returning fetches)
+                from distributed_tensorflow_guide_tpu.utils.watchdog import (
+                    DataStallError,
+                )
+
+                raise DataStallError(
+                    f"data iterator stalled: one fetch took "
+                    f"{t1 - t0:.2f}s > max_host_wait_s="
+                    f"{self.max_host_wait_s:g}s "
+                    f"(after {self.stats.batches} batches)"
+                )
             self._buf.append(self._put(host_batch))
             t2 = time.perf_counter()
-            self.stats.host_wait_s += t1 - t0
             self.stats.put_s += t2 - t1
             self.stats.peak_ahead = max(self.stats.peak_ahead,
                                         len(self._buf))
@@ -183,7 +208,9 @@ def prefetch_to_device(source: Iterable, *, sharding: Any = None,
                        depth: int = 2,
                        put_fn: Callable[[Any], Any] | None = None,
                        steps_per_call: int = 1,
-                       drop_remainder: bool = True) -> DevicePrefetchIterator:
+                       drop_remainder: bool = True,
+                       max_host_wait_s: float | None = None,
+                       ) -> DevicePrefetchIterator:
     """One-call assembly of the input overlap stage.
 
     ``steps_per_call > 1`` inserts :func:`pack_stream` upstream, so each
@@ -194,4 +221,5 @@ def prefetch_to_device(source: Iterable, *, sharding: Any = None,
         source = pack_stream(source, steps_per_call,
                              drop_remainder=drop_remainder)
     return DevicePrefetchIterator(source, sharding=sharding, depth=depth,
-                                  put_fn=put_fn)
+                                  put_fn=put_fn,
+                                  max_host_wait_s=max_host_wait_s)

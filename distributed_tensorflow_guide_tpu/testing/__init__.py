@@ -1,0 +1,6 @@
+from distributed_tensorflow_guide_tpu.testing.chaos import (  # noqa: F401
+    ChaosInjectedError,
+    Fault,
+    FaultSchedule,
+    corrupt_checkpoint,
+)

@@ -1,0 +1,250 @@
+"""Deterministic chaos harness — seeded fault injection for the resilience
+layer.
+
+The reference guide's failure story was untested because failures were
+unproducible: you killed a PS by hand and watched workers hang. This module
+makes every failure class the resilience layer claims to handle injectable
+*deterministically* — a seeded :class:`FaultSchedule` fires the same faults
+at the same points on every run — so tests can assert the strongest
+property there is: a faulted supervised run ends **bitwise identical** to an
+uninterrupted one (tests/test_chaos.py), and ``benchmarks/bench_resilience.py``
+can measure recovery MTTR and goodput under a reproducible storm.
+
+Fault classes and where they fire:
+
+==================  =========================================================
+``step_exception``  raised from inside the step function at the Nth step-fn
+                    invocation (``wrap_step``) — a host-visible step crash
+``nan_batch``       the batch at absolute stream position N is replaced with
+                    NaNs (``inject_data``) — data poison for the sentinel
+``iterator_stall``  the fetch of position N sleeps ``param`` seconds
+                    (``inject_data``) — the watchdog's prey
+``ckpt_truncate``   the newest committed checkpoint's largest payload file is
+                    truncated when position N is reached (``inject_data``) —
+                    post-commit corruption for the restore ladder
+``ckpt_corrupt``    same, but bytes are flipped in place (size unchanged —
+                    only the CRC manifest can catch it)
+==================  =========================================================
+
+Mid-save process kills are process-level, not stream-level: use
+``runtime.multiprocess.MultiProcessRunner.kill`` directly (see the chaos
+tests). Every fault is one-shot — after it fires once it never fires again,
+which is what makes replay-after-recovery converge (and is also how real
+transients behave; persistent data poison is modeled by the underlying
+stream itself, plus the sentinel's ``skip_offending``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from pathlib import Path
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import numpy as np
+
+log = logging.getLogger("dtg.chaos")
+
+DATA_KINDS = ("nan_batch", "iterator_stall", "ckpt_truncate", "ckpt_corrupt")
+STEP_KINDS = ("step_exception",)
+KINDS = STEP_KINDS + DATA_KINDS
+
+
+class ChaosInjectedError(RuntimeError):
+    """The injected step exception (recoverable by design)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scheduled fault. ``position`` is the absolute stream position for
+    data-kind faults, the (1-based counting from 0) step-fn invocation index
+    for ``step_exception``. ``param`` is kind-specific: stall seconds for
+    ``iterator_stall``, unused otherwise."""
+
+    kind: str
+    position: int
+    param: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(choose from {KINDS})")
+
+
+def _poison(batch: Any) -> Any:
+    """Replace every float leaf with NaNs (ints/bools pass through)."""
+    import jax
+
+    def bad(x):
+        arr = np.asarray(x)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, np.nan)
+        return x
+
+    return jax.tree.map(bad, batch)
+
+
+def _interruptible_sleep(seconds: float) -> None:
+    """Sleep in small slices so a watchdog ``interrupt_main`` can land
+    between bytecodes — a single long C-level sleep would be opaque to it
+    (the honest limitation utils/watchdog.py documents)."""
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        time.sleep(0.02)
+
+
+def corrupt_checkpoint(directory: str | Path, step: int | None = None, *,
+                       mode: str = "truncate") -> tuple[int, str]:
+    """Damage a committed checkpoint in place — the post-commit corruption
+    (partial fsync loss, bit rot, an overzealous cleanup job) the manifest
+    + restore ladder exist for.
+
+    ``mode="truncate"`` halves the largest payload file (size changes —
+    caught by the manifest's size check); ``mode="flip"`` inverts its
+    middle bytes (size unchanged — only the CRC catches it). ``step=None``
+    targets the newest committed step. Returns ``(step, relative_path)``.
+    """
+    directory = Path(directory)
+    steps = sorted(
+        int(p.name) for p in directory.iterdir()
+        if p.is_dir() and p.name.isdigit()
+    )
+    if not steps:
+        raise FileNotFoundError(f"no committed checkpoints under {directory}")
+    step = steps[-1] if step is None else step
+    step_dir = directory / str(step)
+    target = max(
+        (p for p in step_dir.rglob("*") if p.is_file()),
+        key=lambda p: p.stat().st_size,
+    )
+    data = target.read_bytes()
+    if mode == "truncate":
+        target.write_bytes(data[: len(data) // 2])
+    elif mode == "flip":
+        mid = len(data) // 2
+        span = max(1, min(64, len(data) - mid))
+        mutated = bytes(b ^ 0xFF for b in data[mid:mid + span])
+        target.write_bytes(data[:mid] + mutated + data[mid + span:])
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    rel = str(target.relative_to(step_dir))
+    log.warning("chaos: %s checkpoint step %d file %s", mode, step, rel)
+    return step, rel
+
+
+class FaultSchedule:
+    """A seeded, one-shot fault plan shared across a supervised run.
+
+    The SAME instance must wrap both the step function and the data maker
+    of every restart attempt (``run_with_recovery`` re-calls ``make_data``
+    per attempt; the schedule's fired-set persists across them) — that is
+    what makes each fault fire exactly once per run.
+    """
+
+    def __init__(self, faults: Sequence[Fault] = ()):
+        self.faults = sorted(faults, key=lambda f: (f.position, f.kind))
+        self.fired: list[Fault] = []
+        self._pending = set(self.faults)
+        self._step_calls = 0
+
+    @classmethod
+    def random(cls, seed: int, *, max_position: int,
+               kinds: Sequence[str] = KINDS, n_faults: int = 3,
+               min_position: int = 1,
+               stall_s: float = 0.5) -> "FaultSchedule":
+        """Deterministic-in-``seed`` schedule: ``n_faults`` distinct
+        positions in ``[min_position, max_position)``, kinds drawn
+        uniformly. Same seed → identical schedule, always."""
+        if max_position - min_position < n_faults:
+            raise ValueError(
+                f"cannot place {n_faults} faults in "
+                f"[{min_position}, {max_position})")
+        rng = np.random.RandomState(seed)
+        positions = rng.choice(
+            np.arange(min_position, max_position), size=n_faults,
+            replace=False,
+        )
+        chosen = rng.choice(len(kinds), size=n_faults)
+        return cls([
+            Fault(kinds[int(k)], int(p),
+                  stall_s if kinds[int(k)] == "iterator_stall" else 0.0)
+            for p, k in zip(positions, chosen)
+        ])
+
+    @property
+    def pending(self) -> list[Fault]:
+        return sorted(self._pending, key=lambda f: (f.position, f.kind))
+
+    def _take(self, position: int, kinds: Sequence[str]) -> list[Fault]:
+        due = [f for f in self._pending
+               if f.position == position and f.kind in kinds]
+        for f in due:
+            self._pending.discard(f)
+            self.fired.append(f)
+        return due
+
+    # ---- injectors ---------------------------------------------------------
+
+    def wrap_step(self, step_fn: Callable) -> Callable:
+        """Raise :class:`ChaosInjectedError` at the scheduled step-fn
+        invocation indices (counting every invocation, replays included —
+        execution order under a fixed schedule is deterministic, so the
+        whole faulted run is too)."""
+
+        def chaotic_step(state, batch):
+            call = self._step_calls
+            self._step_calls += 1
+            for f in self._take(call, STEP_KINDS):
+                log.warning("chaos: injected step exception at call %d",
+                            call)
+                raise ChaosInjectedError(
+                    f"chaos: injected step exception (call {call})")
+            return step_fn(state, batch)
+
+        return chaotic_step
+
+    def inject_data(self, make_data: Callable[[int], Iterable], *,
+                    checkpoint_dir: str | Path | None = None,
+                    ) -> Callable[[int], Iterator]:
+        """Wrap a ``make_data(start)`` maker: data-kind faults fire when the
+        stream reaches their absolute position. Checkpoint-corruption kinds
+        need ``checkpoint_dir`` (they damage the newest committed save at
+        that moment — i.e. *after* the checkpoints earlier positions
+        produced, which is what makes the ladder's fallback observable)."""
+
+        def wrapped(start: int) -> Iterator:
+            def gen():
+                pos = start
+                for batch in make_data(start):
+                    for f in self._take(pos, DATA_KINDS):
+                        batch = self._fire_data(f, batch, checkpoint_dir)
+                    yield batch
+                    pos += 1
+
+            return gen()
+
+        return wrapped
+
+    def _fire_data(self, fault: Fault, batch: Any,
+                   checkpoint_dir: str | Path | None) -> Any:
+        log.warning("chaos: firing %s at position %d",
+                    fault.kind, fault.position)
+        if fault.kind == "nan_batch":
+            return _poison(batch)
+        if fault.kind == "iterator_stall":
+            _interruptible_sleep(fault.param)
+            return batch
+        # ckpt_truncate / ckpt_corrupt
+        if checkpoint_dir is None:
+            raise ValueError(
+                f"{fault.kind} fault needs inject_data(checkpoint_dir=...)")
+        try:
+            corrupt_checkpoint(
+                checkpoint_dir,
+                mode="truncate" if fault.kind == "ckpt_truncate" else "flip",
+            )
+        except FileNotFoundError:
+            log.warning("chaos: %s at position %d found no committed "
+                        "checkpoint to damage", fault.kind, fault.position)
+        return batch

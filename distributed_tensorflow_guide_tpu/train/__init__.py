@@ -7,9 +7,15 @@ from distributed_tensorflow_guide_tpu.train.hooks import (  # noqa: F401
     StopAtStepHook,
 )
 from distributed_tensorflow_guide_tpu.train.loop import TrainLoop  # noqa: F401
+from distributed_tensorflow_guide_tpu.train.anomaly import (  # noqa: F401
+    AnomalyBudgetExceeded,
+    AnomalyDetected,
+    AnomalySentinelHook,
+)
 from distributed_tensorflow_guide_tpu.train.checkpoint import (  # noqa: F401
     Checkpointer,
     CheckpointHook,
+    LayoutMismatchError,
 )
 from distributed_tensorflow_guide_tpu.train.elastic import (  # noqa: F401
     PreemptionHook,

@@ -10,11 +10,37 @@ every process participates in saving its local shards (no chief bottleneck,
 no PS round-trip), and restore lays shards back onto the live mesh. Resume is
 restore + the step counter, exactly the reference's recovery model (SURVEY.md
 §5 checkpoint row).
+
+Resilience layer (docs/resilience.md):
+
+* **Async saves** — ``save(..., async_=True)`` returns as soon as the device
+  state is snapshotted to host (orbax copies D2H before returning, so donated
+  buffers are safe to reuse); serialization runs in orbax's background
+  thread. The *commit barrier* is the next ``save``/``restore``/``wait``/
+  ``latest_step``/``close`` call: it joins the background write, surfaces
+  any background error, and only then writes the manifest — so an
+  async-saved step never looks durable before it is.
+* **Integrity manifest** — every committed save gets a chief-written
+  ``manifest_<step>.json`` sidecar (per-file size + CRC32, written
+  atomically, *after* the payload is durable). It is the commit marker the
+  restore ladder trusts: a checkpoint that was truncated or bit-flipped
+  after commit fails verification instead of poisoning a restore.
+* **Restore ladder** — :meth:`restore_latest_valid` walks checkpoints newest
+  to oldest, skipping any that fail verification or restore, so one corrupt
+  newest checkpoint degrades recovery by one save interval instead of
+  crash-looping it.
+* **Startup hygiene** — ``__init__`` removes stale orbax tmp dirs and
+  half-written manifests left by a kill-mid-save, keeping ``max_to_keep``
+  accounting and disk usage correct across restarts.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
+import shutil
+import zlib
 from pathlib import Path
 from typing import Any
 
@@ -30,6 +56,29 @@ log = logging.getLogger("dtg.train")
 # inspecting a foreign-topology export with a pinned Checkpointer).
 _UNSET: Any = object()
 
+_ORBAX_TMP_MARKER = ".orbax-checkpoint-tmp-"
+_MANIFEST_TMP_SUFFIX = ".tmp"
+
+
+class LayoutMismatchError(ValueError):
+    """Restoring model's layout identity differs from the saved one."""
+
+
+def _crc32(path: Path) -> int:
+    crc = 0
+    with path.open("rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc
+
+
+def _is_writer() -> bool:
+    """Manifests are chief-written: one writer per shared directory."""
+    try:
+        return jax.process_index() == 0
+    except Exception:  # pragma: no cover - backend not initialized
+        return True
+
 
 class Checkpointer:
     """Thin wrapper over ocp.CheckpointManager for train states."""
@@ -44,6 +93,8 @@ class Checkpointer:
         ``layout_metadata()`` once."""
         self.directory = Path(directory).absolute()
         self.default_layout = default_layout
+        self._pending_step: int | None = None
+        self.cleaned_on_start = self._clean_stale_tmp()
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -51,51 +102,166 @@ class Checkpointer:
             ),
         )
 
+    # ---- startup hygiene ---------------------------------------------------
+
+    def _clean_stale_tmp(self) -> list[str]:
+        """Remove kill-mid-save debris: uncommitted orbax tmp step dirs and
+        half-written manifest tmp files. Without this, tmp dirs accumulate
+        forever (orbax's atomic-rename commit never reclaims them) and eat
+        the disk budget ``max_to_keep`` is supposed to bound."""
+        if not self.directory.is_dir():
+            return []
+        removed = []
+        for p in self.directory.iterdir():
+            name = p.name
+            if p.is_dir() and _ORBAX_TMP_MARKER in name:
+                shutil.rmtree(p, ignore_errors=True)
+                removed.append(name)
+            elif (p.is_file() and name.startswith("manifest_")
+                  and name.endswith(_MANIFEST_TMP_SUFFIX)):
+                p.unlink(missing_ok=True)
+                removed.append(name)
+        if removed:
+            log.warning(
+                "checkpoint startup hygiene: removed %d stale tmp "
+                "artifact(s) left by an interrupted save under %s: %s",
+                len(removed), self.directory, sorted(removed),
+            )
+        return removed
+
+    # ---- save --------------------------------------------------------------
+
     def save(self, step: int, state: Any, *, force: bool = False,
-             layout: dict | None = _UNSET) -> bool:
+             layout: dict | None = _UNSET, async_: bool = False) -> bool:
         """``layout``: optional layout-identity dict (e.g. a pipelined
         model's ``layout_metadata()``) written as a sidecar and validated
         on restore. Guards against shape-identical-but-permuted trees:
         an interleaved (P=2, v=2) stage stack restores cleanly into a
         (P=4, v=1) model — same shapes, wrong layer order — unless the
         layout is pinned. Unspecified -> ``self.default_layout``; an
-        explicit ``layout=None`` forces a layout-less save."""
+        explicit ``layout=None`` forces a layout-less save.
+
+        ``async_=True``: return once the device state is snapshotted to
+        host; serialization and the manifest commit happen at the next
+        barrier (see module docstring). ``async_=False`` blocks until the
+        checkpoint is durable and verified-manifest-committed — the step
+        pays the full serialization cost, which is exactly the sync-vs-
+        async A/B ``benchmarks/bench_resilience.py`` measures."""
         if layout is _UNSET:
             layout = self.default_layout
+        self._commit_pending()
         if step in self._mngr.all_steps():  # labels are immutable step counts
             return False
         saved = self._mngr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
-        if saved:
-            sidecar = self.directory / f"layout_{step}.json"
-            if layout is not None:
-                import json
-
-                sidecar.write_text(json.dumps(layout, sort_keys=True))
-            else:
-                # a layout-less save must invalidate any orphaned sidecar
-                # from an earlier run that reused this step number
-                sidecar.unlink(missing_ok=True)
+        if not saved:
+            return False
+        # the layout sidecar is metadata, not the commit marker — safe to
+        # write before the payload is durable
+        self._write_sidecar(step, layout)
+        if async_:
+            self._pending_step = step
+            log.info("async checkpoint at step %d enqueued -> %s",
+                     step, self.directory)
+        else:
+            self._mngr.wait_until_finished()
+            self._write_manifest(step)
             self._gc_sidecars()
             log.info("saved checkpoint at step %d -> %s", step, self.directory)
         return saved
 
+    def _commit_pending(self) -> None:
+        """The async-save commit barrier: join the background write (this
+        re-raises any background save error here, at a caller that can act
+        on it) and only then write the manifest that marks the step valid."""
+        if self._pending_step is None:
+            return
+        step, self._pending_step = self._pending_step, None
+        self._mngr.wait_until_finished()
+        self._write_manifest(step)
+        self._gc_sidecars()
+        log.info("async checkpoint at step %d committed", step)
+
+    def _write_sidecar(self, step: int, layout: dict | None) -> None:
+        sidecar = self.directory / f"layout_{step}.json"
+        if layout is not None:
+            sidecar.write_text(json.dumps(layout, sort_keys=True))
+        else:
+            # a layout-less save must invalidate any orphaned sidecar
+            # from an earlier run that reused this step number
+            sidecar.unlink(missing_ok=True)
+
+    def _manifest_path(self, step: int) -> Path:
+        return self.directory / f"manifest_{step}.json"
+
+    def _write_manifest(self, step: int) -> None:
+        """Per-file size+CRC32 manifest, written atomically AFTER the
+        payload is durable — the write order is the integrity contract:
+        manifest present => every payload byte it lists was on disk."""
+        if not _is_writer():
+            return
+        step_dir = self.directory / str(step)
+        if not step_dir.is_dir():  # pragma: no cover - save failed upstream
+            return
+        files = {
+            str(p.relative_to(step_dir)): [p.stat().st_size, _crc32(p)]
+            for p in sorted(step_dir.rglob("*")) if p.is_file()
+        }
+        target = self._manifest_path(step)
+        tmp = target.with_name(target.name + _MANIFEST_TMP_SUFFIX)
+        tmp.write_text(json.dumps({"step": step, "files": files}))
+        os.replace(tmp, target)
+
     def _gc_sidecars(self) -> None:
-        """Drop sidecars whose step was garbage-collected by orbax
+        """Drop sidecars/manifests whose step was garbage-collected by orbax
         (max_to_keep) — a stale layout_{n}.json would otherwise poison a
         later run that reuses step n in this directory."""
         live = set(self._mngr.all_steps())
-        for p in self.directory.glob("layout_*.json"):
-            try:
-                n = int(p.stem.removeprefix("layout_"))
-            except ValueError:  # pragma: no cover - foreign file
-                continue
-            if n not in live:
-                p.unlink(missing_ok=True)
+        for prefix in ("layout_", "manifest_"):
+            for p in self.directory.glob(f"{prefix}*.json"):
+                try:
+                    n = int(p.stem.removeprefix(prefix))
+                except ValueError:  # pragma: no cover - foreign file
+                    continue
+                if n not in live:
+                    p.unlink(missing_ok=True)
+
+    # ---- verify / restore --------------------------------------------------
 
     def latest_step(self) -> int | None:
+        self._commit_pending()
         return self._mngr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        self._commit_pending()
+        return sorted(self._mngr.all_steps())
+
+    def verify_step(self, step: int) -> bool:
+        """True iff the step's payload matches its manifest (size + CRC32
+        per file). A committed checkpoint with no manifest (written by an
+        older run) is unverifiable and passes — the restore ladder's
+        try/except still guards it."""
+        step_dir = self.directory / str(step)
+        if not step_dir.is_dir():
+            return False
+        mpath = self._manifest_path(step)
+        if not mpath.exists():
+            return True
+        try:
+            manifest = json.loads(mpath.read_text())
+        except (OSError, json.JSONDecodeError):
+            return False
+        for rel, (size, crc) in manifest.get("files", {}).items():
+            p = step_dir / rel
+            if not p.is_file() or p.stat().st_size != size:
+                log.warning("checkpoint step %d: %s missing or truncated",
+                            step, rel)
+                return False
+            if _crc32(p) != crc:
+                log.warning("checkpoint step %d: %s fails CRC32", step, rel)
+                return False
+        return True
 
     def restore(self, state_like: Any, step: int | None = None, *,
                 layout: dict | None = _UNSET) -> Any:
@@ -113,17 +279,16 @@ class Checkpointer:
         """
         if layout is _UNSET:
             layout = self.default_layout
+        self._commit_pending()
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
         if layout is not None:
             sidecar = self.directory / f"layout_{step}.json"
             if sidecar.exists():
-                import json
-
                 saved = json.loads(sidecar.read_text())
                 if saved != layout:
-                    raise ValueError(
+                    raise LayoutMismatchError(
                         f"checkpoint layout mismatch at step {step}: saved "
                         f"{saved}, restoring model expects {layout} — same "
                         "tree shapes do NOT imply the same layer order "
@@ -132,19 +297,72 @@ class Checkpointer:
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
         return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
 
+    def restore_latest_valid(
+        self, state_like: Any, *, layout: dict | None = _UNSET
+    ) -> tuple[Any, int] | None:
+        """The restore ladder: walk checkpoints newest→oldest, skip any that
+        fail manifest verification or raise during restore, return
+        ``(state, step)`` from the newest valid one. Returns ``None`` when
+        nothing restorable exists (no checkpoints, or all corrupt — the
+        caller degrades to a fresh start, which is the crash-only answer).
+        A :class:`LayoutMismatchError` is a configuration error, not
+        corruption, and re-raises."""
+        self._commit_pending()
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        skipped: list[int] = []
+        for step in steps:
+            if not self.verify_step(step):
+                skipped.append(step)
+                continue
+            try:
+                state = self.restore(state_like, step=step, layout=layout)
+            except LayoutMismatchError:
+                raise
+            except Exception as e:  # corrupt payload the manifest missed
+                log.warning("checkpoint step %d failed to restore (%s)",
+                            step, e)
+                skipped.append(step)
+                continue
+            if skipped:
+                log.warning(
+                    "restore ladder: skipped corrupt/invalid step(s) %s, "
+                    "restored step %d from %s",
+                    skipped, step, self.directory,
+                )
+            return state, step
+        if skipped:
+            log.error(
+                "restore ladder: ALL checkpoint step(s) %s under %s are "
+                "corrupt/invalid — degrading to a fresh start",
+                skipped, self.directory,
+            )
+        return None
+
     def wait(self) -> None:
+        self._commit_pending()
         self._mngr.wait_until_finished()
 
     def close(self) -> None:
-        self._mngr.close()
+        try:
+            self._commit_pending()
+        finally:
+            self._mngr.close()
 
 
 class CheckpointHook(BaseHook):
-    """Save every N steps + at end (CheckpointSaverHook equivalent)."""
+    """Save every N steps + at end (CheckpointSaverHook equivalent).
 
-    def __init__(self, checkpointer: Checkpointer, every_steps: int = 1000):
+    ``async_save=True`` makes the periodic saves asynchronous: the step
+    pays only the host snapshot, and durability is settled at the next
+    save's barrier (or the final sync save in ``end``). The end-of-run
+    save is always synchronous — the loop's contract is that a finished
+    run's newest checkpoint is durable."""
+
+    def __init__(self, checkpointer: Checkpointer, every_steps: int = 1000,
+                 *, async_save: bool = False):
         self.ckpt = checkpointer
         self.every_steps = every_steps
+        self.async_save = async_save
         self._loop = None
 
     def begin(self, loop) -> None:
@@ -156,7 +374,7 @@ class CheckpointHook(BaseHook):
         # start_step=latest_step() never replays an already-applied update.
         done = step + 1
         if done % self.every_steps == 0:
-            self.ckpt.save(done, self._loop.state)
+            self.ckpt.save(done, self._loop.state, async_=self.async_save)
 
     def end(self, step: int) -> None:
         self.ckpt.save(step, self._loop.state, force=True)

@@ -24,8 +24,12 @@ from __future__ import annotations
 
 import logging
 import signal as _signal
-from typing import Any, Callable, Iterable, Sequence
+from typing import Any, Callable, Iterable, Iterator, Sequence
 
+from distributed_tensorflow_guide_tpu.train.anomaly import (
+    AnomalyDetected,
+    AnomalySentinelHook,
+)
 from distributed_tensorflow_guide_tpu.train.checkpoint import (
     Checkpointer,
     CheckpointHook,
@@ -40,6 +44,32 @@ class TooManyRestarts(RuntimeError):
     pass
 
 
+def _position_of(step: int, skips: set[int]) -> int:
+    """Absolute data position of the ``step``-th *trained* batch, given the
+    positions already skipped: each skipped position at or before the
+    answer shifts it one further down the stream."""
+    pos = step
+    for s in sorted(skips):
+        if s <= pos:
+            pos += 1
+    return pos
+
+
+def _skipping_stream(
+    make_data: Callable[[int], Iterable], start_step: int, skips: set[int]
+) -> Iterator:
+    """Yield the batches for steps ``start_step, start_step+1, ...`` from a
+    stream with the ``skips`` data positions dropped — the replay path
+    after an anomaly rollback asked to skip its offending batch."""
+    first_pos = _position_of(start_step, skips)
+    it = iter(make_data(first_pos))
+    pos = first_pos
+    for batch in it:
+        if pos not in skips:
+            yield batch
+        pos += 1
+
+
 def run_with_recovery(
     step_fn: StepFn,
     init_state: Any,
@@ -50,6 +80,9 @@ def run_with_recovery(
     checkpoint_every: int = 100,
     max_restarts: int = 3,
     recoverable: tuple[type[BaseException], ...] = (RuntimeError,),
+    async_save: bool = False,
+    step_deadline_s: float | None = None,
+    data_deadline_s: float | None = None,
 ) -> Any:
     """Supervised training: run → crash → restore → resume, bounded.
 
@@ -57,19 +90,51 @@ def run_with_recovery(
     ``start_step, start_step+1, ...`` — data position is part of resume
     state, exactly like the reference's global_step-keyed input pipelines.
     Returns the final train state.
+
+    Restores go through the checkpointer's restore ladder
+    (:meth:`Checkpointer.restore_latest_valid`): a corrupt or truncated
+    newest checkpoint costs one save interval of recomputation instead of
+    crash-looping every restart attempt on the same bad files; when NO
+    valid checkpoint exists the run degrades to a fresh start.
+
+    Anomaly handling: :class:`~.anomaly.AnomalySentinelHook` instances in
+    ``hooks`` are ordered BEFORE the CheckpointHook (a tripped step must
+    not be saved), and a trip with ``skip_offending=True`` drops the
+    offending batch position from every subsequent replay. ``async_save``
+    makes the periodic checkpoints asynchronous (see CheckpointHook);
+    ``step_deadline_s``/``data_deadline_s`` arm the loop's watchdog so a
+    hang becomes a recoverable :class:`~.utils.watchdog.WatchdogTimeout`
+    instead of a silent stall.
     """
     restarts = 0
+    sentinels = [h for h in hooks if isinstance(h, AnomalySentinelHook)]
+    others = [h for h in hooks if not isinstance(h, AnomalySentinelHook)]
+    for s in sentinels:
+        # force a check on every save boundary: a check_every cadence that
+        # misses the step before a save must not let poison be persisted
+        s.save_cadence = checkpoint_every
+    skips: set[int] = set()
     while True:
-        start = checkpointer.latest_step() or 0
-        state = (
-            checkpointer.restore(init_state) if start else init_state
+        restored = checkpointer.restore_latest_valid(init_state)
+        if restored is None:
+            state, start = init_state, 0
+        else:
+            state, start = restored
+        data = (
+            _skipping_stream(make_data, start, skips)
+            if skips else make_data(start)
         )
         loop = TrainLoop(
             step_fn,
             state,
-            make_data(start),
-            hooks=[CheckpointHook(checkpointer, checkpoint_every), *hooks],
+            data,
+            hooks=[*sentinels,
+                   CheckpointHook(checkpointer, checkpoint_every,
+                                  async_save=async_save),
+                   *others],
             start_step=start,
+            step_deadline_s=step_deadline_s,
+            data_deadline_s=data_deadline_s,
         )
         try:
             return loop.run()
@@ -79,6 +144,20 @@ def run_with_recovery(
                 raise TooManyRestarts(
                     f"gave up after {max_restarts} restarts: {e}"
                 ) from e
+            if isinstance(e, AnomalyDetected) and e.skip_offending:
+                # the whole cannot-exonerate window (every step since the
+                # sentinel's last clean check — just the one step at
+                # check_every=1) is dropped: skipping only the detection
+                # step would leave the actual poison in the replay when
+                # the cadence is coarser. Positions are resolved against
+                # the CURRENT skip set before any are added.
+                window = range(e.window_start, e.step + 1)
+                positions = {_position_of(s, skips) for s in window}
+                skips |= positions
+                log.warning(
+                    "anomaly at step %d: skipping data position(s) %s on "
+                    "replay", e.step, sorted(positions),
+                )
             log.warning(
                 "step %d failed (%s); restart %d/%d from checkpoint",
                 loop.step, e, restarts, max_restarts,

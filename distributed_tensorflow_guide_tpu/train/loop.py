@@ -67,6 +67,10 @@ class TrainLoop:
         start_step: int = 0,
         steps_per_call: int = 1,
         tail_step_fn: StepFn | None = None,
+        step_deadline_s: float | None = None,
+        data_deadline_s: float | None = None,
+        watchdog_action: Any = "interrupt",
+        watchdog_diag_path: Any = None,
     ):
         if steps_per_call < 1:
             raise ValueError(
@@ -78,6 +82,18 @@ class TrainLoop:
         self.step = start_step
         self.steps_per_call = steps_per_call
         self.tail_step_fn = tail_step_fn
+        # Watchdog deadlines (utils/watchdog.py): ``data_deadline_s`` bounds
+        # one fetch from the data iterator, ``step_deadline_s`` bounds one
+        # dispatch + hook fan-out (NOT device completion — dispatch is
+        # async; a wedged device surfaces here at the next blocking metric
+        # read, which the step guard covers). A trip dumps all-thread
+        # stacks and converts the hang into a fail-fast WatchdogTimeout
+        # (action="interrupt") or a process exit the multiprocess
+        # supervisor restarts (action="kill").
+        self.step_deadline_s = step_deadline_s
+        self.data_deadline_s = data_deadline_s
+        self.watchdog_action = watchdog_action
+        self.watchdog_diag_path = watchdog_diag_path
         self._stop = False
         self.stop_reason: str | None = None
         self._last_return: float | None = None
@@ -184,27 +200,60 @@ class TrainLoop:
         ``end`` where crashes rightly skip it.
         """
         self._last_return = None
+        wd = None
+        if self.step_deadline_s or self.data_deadline_s:
+            from distributed_tensorflow_guide_tpu.utils.watchdog import (
+                Watchdog,
+            )
+
+            wd = Watchdog(name="train-loop", action=self.watchdog_action,
+                          diag_path=self.watchdog_diag_path)
         try:
-            # begin() inside the try: if a later hook's begin raises, the
-            # finally still runs cleanup() for already-begun hooks (e.g.
-            # PreemptionHook's process-wide signal handler)
-            for h in self.hooks:
-                h.begin(self)
-            it: Iterator = iter(self.data)
-            while not self._stop:
-                try:
-                    batch = next(it)
-                except StopIteration:
-                    break
-                if self.steps_per_call > 1:
-                    self._run_packed(batch)
-                else:
-                    self._after_step(self._dispatch(self.step_fn, batch))
-            for h in self.hooks:
-                h.end(self.step)
-        finally:
-            for h in self.hooks:
-                cleanup = getattr(h, "cleanup", None)
-                if cleanup is not None:
-                    cleanup()
-        return self.state
+            try:
+                # begin() inside the try: if a later hook's begin raises,
+                # the finally still runs cleanup() for already-begun hooks
+                # (e.g. PreemptionHook's process-wide signal handler)
+                for h in self.hooks:
+                    h.begin(self)
+                it: Iterator = iter(self.data)
+                while not self._stop:
+                    if wd and self.data_deadline_s:
+                        wd.arm("data iterator", self.data_deadline_s)
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    finally:
+                        if wd:
+                            wd.disarm()
+                            wd.check()
+                    if wd and self.step_deadline_s:
+                        wd.arm("train step", self.step_deadline_s)
+                    if self.steps_per_call > 1:
+                        self._run_packed(batch)
+                    else:
+                        self._after_step(
+                            self._dispatch(self.step_fn, batch))
+                    if wd:
+                        wd.disarm()
+                        wd.check()
+                for h in self.hooks:
+                    h.end(self.step)
+            finally:
+                if wd is not None:
+                    wd.close()
+                for h in self.hooks:
+                    cleanup = getattr(h, "cleanup", None)
+                    if cleanup is not None:
+                        cleanup()
+            return self.state
+        except KeyboardInterrupt:
+            # an "interrupt"-action watchdog trip arrives as
+            # KeyboardInterrupt wherever the main thread happens to be
+            # executing — possibly a few bytecodes late, inside the
+            # cleanup finally above, which is why this converter wraps the
+            # WHOLE body: check() re-raises the clean fail-fast error; a
+            # genuine Ctrl-C (no trip recorded) re-raises untouched
+            if wd is not None:
+                wd.check()
+            raise

@@ -7,3 +7,8 @@ from distributed_tensorflow_guide_tpu.utils.tb_writer import (  # noqa: F401
     SummaryWriter,
     read_scalars,
 )
+from distributed_tensorflow_guide_tpu.utils.watchdog import (  # noqa: F401
+    DataStallError,
+    Watchdog,
+    WatchdogTimeout,
+)

@@ -1,0 +1,283 @@
+"""Anomaly sentinel: NaN/spike detection, rollback via run_with_recovery,
+bounded anomaly budget, and the skip-offending escape hatch for persistent
+data poison (train/anomaly.py + train/elastic.py wiring)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_guide_tpu.train.anomaly import (
+    AnomalyBudgetExceeded,
+    AnomalyDetected,
+    AnomalySentinelHook,
+)
+from distributed_tensorflow_guide_tpu.train.checkpoint import Checkpointer
+from distributed_tensorflow_guide_tpu.train.elastic import run_with_recovery
+from distributed_tensorflow_guide_tpu.train.hooks import StopAtStepHook
+from distributed_tensorflow_guide_tpu.train.loop import TrainLoop
+
+TOTAL = 20
+CKPT_EVERY = 5
+
+
+def _step_fn(state, batch):
+    params = state["params"]
+    grad = 2 * params + batch
+    return {"params": params - 0.01 * grad}, {
+        "loss": jnp.sum(params ** 2) + jnp.sum(batch) * 0.0
+    }
+
+
+def _init():
+    return {"params": jnp.ones((4,))}
+
+
+def _clean_data(start):
+    return (jnp.full((4,), float(s)) for s in range(start, 10_000))
+
+
+# ---- detection unit behavior -----------------------------------------------
+
+
+def test_nan_loss_trips_immediately():
+    hook = AnomalySentinelHook(budget=5)
+    with pytest.raises(AnomalyDetected, match="not finite"):
+        hook.after_step(0, {"loss": float("nan")})
+    assert hook.trips and hook.trips[0][0] == 0
+
+
+def test_nan_grad_norm_trips():
+    hook = AnomalySentinelHook(budget=5)
+    with pytest.raises(AnomalyDetected, match="grad_norm"):
+        hook.after_step(0, {"loss": 1.0, "grad_norm": float("inf")})
+
+
+def test_spike_needs_full_window_then_trips():
+    hook = AnomalySentinelHook(spike_factor=10.0, window=5, budget=5)
+    for s in range(5):
+        hook.after_step(s, {"loss": 1.0})  # fills the window, no trip
+    hook.after_step(5, {"loss": 9.0})  # under 10x median: fine
+    with pytest.raises(AnomalyDetected, match="spiked"):
+        hook.after_step(6, {"loss": 100.0})
+
+
+def test_warmup_spike_does_not_false_trip():
+    hook = AnomalySentinelHook(spike_factor=2.0, window=10, budget=1)
+    # wild but finite warmup losses within an unfilled window: no trip
+    for s, loss in enumerate([100.0, 3.0, 55.0, 0.1]):
+        hook.after_step(s, {"loss": loss})
+    assert hook.trips == []
+
+
+def test_check_every_skips_off_cadence_steps():
+    hook = AnomalySentinelHook(check_every=4, budget=5)
+    hook.after_step(1, {"loss": float("nan")})  # off-cadence: unchecked
+    with pytest.raises(AnomalyDetected):
+        hook.after_step(4, {"loss": float("nan")})
+
+
+def test_grad_norm_spike_trips_on_its_own_history():
+    """Review fix: grad-norm gets its OWN median history, so the classic
+    optimizer blow-up — grad_norm jumps 100x while loss still looks sane —
+    is detected, not just grad-norm non-finiteness."""
+    hook = AnomalySentinelHook(spike_factor=10.0, window=4, budget=5)
+    for s in range(4):
+        hook.after_step(s, {"loss": 1.0, "grad_norm": 2.0})
+    with pytest.raises(AnomalyDetected, match="grad_norm=200"):
+        hook.after_step(4, {"loss": 1.0, "grad_norm": 200.0})
+
+
+def test_detection_window_covers_unchecked_steps():
+    """With check_every>1 the trip cannot exonerate the unchecked steps
+    since the last clean check: the AnomalyDetected window must span them
+    (the supervisor skips the whole window, not just the detection step)."""
+    hook = AnomalySentinelHook(check_every=5, budget=5)
+
+    class _Loop:
+        step = 0
+
+    hook.begin(_Loop())
+    hook.after_step(0, {"loss": 1.0})  # clean check -> window starts at 1
+    with pytest.raises(AnomalyDetected) as e:
+        hook.after_step(5, {"loss": float("nan")})
+    assert e.value.window_start == 1 and e.value.step == 5
+
+
+def test_save_cadence_forces_check_before_save_boundary():
+    """run_with_recovery sets save_cadence: the step right before every
+    save is checked even when check_every's own cadence misses it — the
+    'poison is never persisted' guarantee must be cadence-independent."""
+    hook = AnomalySentinelHook(check_every=50, budget=5)
+    hook.save_cadence = 5
+    hook.after_step(1, {"loss": float("nan")})  # neither cadence: skipped
+    with pytest.raises(AnomalyDetected):
+        hook.after_step(4, {"loss": float("nan")})  # done=5 save boundary
+
+
+def test_budget_exceeded_is_not_recoverable_type():
+    hook = AnomalySentinelHook(budget=2)
+    for step in (0, 1):
+        with pytest.raises(AnomalyDetected):
+            hook.after_step(step, {"loss": float("nan")})
+    with pytest.raises(AnomalyBudgetExceeded):
+        hook.after_step(2, {"loss": float("nan")})
+    assert not isinstance(AnomalyBudgetExceeded("x"), RuntimeError)
+
+
+# ---- supervised rollback ----------------------------------------------------
+
+
+def _run_supervised(make_data, tmpdir, hooks=(), **kw):
+    ckpt = Checkpointer(tmpdir, max_to_keep=3)
+    try:
+        return run_with_recovery(
+            _step_fn, _init(), make_data, ckpt,
+            hooks=[StopAtStepHook(TOTAL), *hooks],
+            checkpoint_every=CKPT_EVERY, **kw,
+        )
+    finally:
+        ckpt.close()
+
+
+def test_transient_nan_rolls_back_to_bitwise_parity(tmp_path):
+    """A one-shot NaN batch trips the sentinel, the supervisor restores the
+    last good checkpoint, the replay sees clean data — final params
+    bitwise-identical to the uninterrupted run (the crash-equivalence
+    oracle extended to the NaN fault class)."""
+    clean = _run_supervised(_clean_data, tmp_path / "clean")
+
+    poisoned = [False]
+
+    def poison_once(start):
+        for s in range(start, 10_000):
+            b = jnp.full((4,), float(s))
+            if s == 12 and not poisoned[0]:
+                poisoned[0] = True
+                b = jnp.full((4,), jnp.nan)
+            yield b
+
+    hook = AnomalySentinelHook(budget=3)
+    out = _run_supervised(poison_once, tmp_path / "nan", hooks=[hook])
+    assert [s for s, _ in hook.trips] == [12]
+    np.testing.assert_array_equal(np.asarray(clean["params"]),
+                                  np.asarray(out["params"]))
+
+
+def test_tripped_step_is_never_checkpointed(tmp_path):
+    """The sentinel is ordered BEFORE the CheckpointHook inside
+    run_with_recovery: a NaN landing exactly on a save boundary must raise
+    before the save runs, so no checkpoint ever holds poisoned params."""
+    poisoned = [False]
+
+    def poison_on_boundary(start):
+        for s in range(start, 10_000):
+            b = jnp.full((4,), float(s))
+            if s == CKPT_EVERY - 1 and not poisoned[0]:  # step 4 -> save 5
+                poisoned[0] = True
+                b = jnp.full((4,), jnp.nan)
+            yield b
+
+    ckpt = Checkpointer(tmp_path / "b", max_to_keep=10)
+    try:
+        run_with_recovery(
+            _step_fn, _init(), poison_on_boundary, ckpt,
+            hooks=[StopAtStepHook(TOTAL), AnomalySentinelHook(budget=3)],
+            checkpoint_every=CKPT_EVERY,
+        )
+        for step in ckpt.all_steps():
+            restored = ckpt.restore(_init(), step=step)
+            assert np.isfinite(np.asarray(restored["params"])).all(), step
+    finally:
+        ckpt.close()
+
+
+def test_persistent_nan_without_skip_burns_budget(tmp_path):
+    """Data poison that re-fires on every replay (the underlying stream is
+    bad, not a transient): plain rollback loops until the anomaly budget
+    stops it loudly."""
+
+    def always_poisoned(start):
+        for s in range(start, 10_000):
+            yield (jnp.full((4,), jnp.nan) if s == 12
+                   else jnp.full((4,), float(s)))
+
+    with pytest.raises(AnomalyBudgetExceeded):
+        _run_supervised(always_poisoned, tmp_path / "p",
+                        hooks=[AnomalySentinelHook(budget=2)],
+                        max_restarts=10)
+
+
+def test_persistent_nan_with_skip_offending_converges(tmp_path):
+    """skip_offending=True drops the poisoned position from the replay:
+    the run completes, and the final params equal the oracle trained on
+    the stream with that element removed."""
+
+    def always_poisoned(start):
+        for s in range(start, 10_000):
+            yield (jnp.full((4,), jnp.nan) if s == 12
+                   else jnp.full((4,), float(s)))
+
+    hook = AnomalySentinelHook(budget=3, skip_offending=True)
+    out = _run_supervised(always_poisoned, tmp_path / "skip", hooks=[hook])
+
+    # oracle: the clean stream with position 12 dropped, run TOTAL steps
+    state = _init()
+    positions = [p for p in range(TOTAL + 1) if p != 12][:TOTAL]
+    for p in positions:
+        state, _ = _step_fn(state, jnp.full((4,), float(p)))
+    np.testing.assert_array_equal(np.asarray(out["params"]),
+                                  np.asarray(state["params"]))
+    assert len(hook.trips) == 1  # one trip, then the skip held
+
+
+def test_persistent_nan_skip_with_coarse_check_cadence(tmp_path):
+    """Review fix: with check_every>1 the poison is detected steps after it
+    struck; skipping only the detection step would replay the poison
+    forever. The whole cannot-exonerate window is skipped instead, so the
+    run converges — to the oracle with those positions removed."""
+
+    def always_poisoned(start):
+        for s in range(start, 10_000):
+            yield (jnp.full((4,), jnp.nan) if s == 7
+                   else jnp.full((4,), float(s)))
+
+    hook = AnomalySentinelHook(budget=3, skip_offending=True, check_every=5)
+    out = _run_supervised(always_poisoned, tmp_path / "coarse", hooks=[hook])
+
+    # poison hits the params entering step 8; the save-boundary check at
+    # step 9 (done=10) trips with window [6..9] -> positions 6..9 skipped
+    assert len(hook.trips) == 1
+    state = _init()
+    positions = [p for p in range(30) if p not in (6, 7, 8, 9)][:TOTAL]
+    for p in positions:
+        state, _ = _step_fn(state, jnp.full((4,), float(p)))
+    np.testing.assert_array_equal(np.asarray(out["params"]),
+                                  np.asarray(state["params"]))
+
+
+def test_budget_exceeded_escapes_run_with_recovery(tmp_path):
+    """AnomalyBudgetExceeded is not a RuntimeError: the default recoverable
+    filter must let it propagate instead of burning max_restarts on it."""
+
+    def all_nan(start):
+        return (jnp.full((4,), jnp.nan) for _ in range(start, 10_000))
+
+    with pytest.raises(AnomalyBudgetExceeded):
+        _run_supervised(all_nan, tmp_path / "esc",
+                        hooks=[AnomalySentinelHook(budget=1)],
+                        max_restarts=50)
+
+
+def test_sentinel_outside_recovery_plain_loop():
+    """Used directly in a TrainLoop (no supervisor), a trip surfaces as the
+    AnomalyDetected error — fail-fast rather than silent poison."""
+    data = iter([jnp.ones((4,)), jnp.full((4,), jnp.nan), jnp.ones((4,))])
+
+    def step(state, batch):
+        return state, {"loss": jnp.sum(batch)}
+
+    loop = TrainLoop(step, {"w": jnp.zeros(2)}, data,
+                     hooks=[AnomalySentinelHook(budget=3)])
+    with pytest.raises(AnomalyDetected):
+        loop.run()
+    assert loop.step == 1

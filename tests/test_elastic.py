@@ -90,6 +90,46 @@ def test_restart_budget_enforced(tmp_path):
         ckpt.close()
 
 
+def test_corrupt_newest_checkpoint_does_not_crash_loop_recovery(tmp_path):
+    """Regression (round-10 satellite): run_with_recovery used to restore
+    only the LATEST step — a truncated newest checkpoint made every restart
+    attempt die on the same bad files until max_restarts, losing a run that
+    had perfectly good older checkpoints. The restore ladder must fall back
+    (and log the skipped step), then extend the run to bitwise parity."""
+    import logging
+
+    from distributed_tensorflow_guide_tpu.testing.chaos import (
+        corrupt_checkpoint,
+    )
+
+    d = tmp_path / "trunc"
+    _run(tmpdir=d)  # saves 5/10/15/20; max_to_keep=2 keeps 15 and 20
+    corrupted_step, _ = corrupt_checkpoint(d, mode="truncate")
+    assert corrupted_step == 20
+
+    ckpt = Checkpointer(d, max_to_keep=2)
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: records.append(rec.getMessage())
+    logging.getLogger("dtg.train").addHandler(handler)
+    try:
+        final = run_with_recovery(
+            _step_fn, _init_state(), _make_data, ckpt,
+            hooks=[StopAtStepHook(30)], checkpoint_every=CKPT_EVERY,
+        )
+    finally:
+        logging.getLogger("dtg.train").removeHandler(handler)
+        ckpt.close()
+    # fallback restored step 15 and logged the skipped step number
+    assert any("restore ladder" in m and "[20]" in m for m in records)
+    state = _init_state()
+    for s, batch in zip(range(30), _make_data(0)):
+        state, _ = _step_fn(state, batch)
+    np.testing.assert_array_equal(
+        np.asarray(final["params"]), np.asarray(state["params"])
+    )
+
+
 def test_resume_from_existing_checkpoint_dir(tmp_path):
     # run to step 20, then extend the same dir to 30 — warm-start resume
     d = tmp_path / "extend"

@@ -428,6 +428,64 @@ def test_subprocess_failure_propagates():
     assert "injected failure on process 1" in bad[0].stderr
 
 
+def test_failure_grace_reaps_peers_within_grace_window():
+    """Round-10 satellite pin for the supervision core: one member exits
+    nonzero → on_first_failure fires once with (pid, code), survivors get
+    ``failure_grace`` seconds and are then killed — the whole join is
+    bounded by the grace window, NOT the wall-clock timeout. Raw Popen
+    sleepers keep this fast (no JAX boot): the semantics under test live
+    entirely in supervise()."""
+    import subprocess
+    import sys
+
+    from distributed_tensorflow_guide_tpu.runtime.multiprocess import (
+        supervise,
+    )
+
+    procs = [
+        subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(3)"]),
+        subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(600)"]),
+        subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(600)"]),
+    ]
+    failures = []
+    t0 = time.monotonic()
+    timed_out = supervise(
+        procs, timeout=300.0, failure_grace=1.0,
+        on_first_failure=lambda pid, code: failures.append((pid, code)),
+    )
+    elapsed = time.monotonic() - t0
+    assert not timed_out
+    assert failures == [(0, 3)]  # fired once, with the right pid and code
+    assert elapsed < 30.0  # grace + poll slack, nowhere near timeout=300
+    codes = [p.returncode for p in procs]
+    assert codes[0] == 3  # the failure's own exit code is preserved
+    assert codes[1] is not None and codes[1] < 0  # survivors were killed
+    assert codes[2] is not None and codes[2] < 0  # (negative = by signal)
+
+
+@pytest.mark.chaos
+def test_runner_kill_reaps_peers_within_grace_not_timeout():
+    """The same pin one level up: a worker SIGKILLed mid-run makes join()
+    return within the grace window against a deliberately huge timeout,
+    with per-ProcessResult exit codes recorded."""
+    import signal as _sig
+
+    runner = MultiProcessRunner(
+        _target_sleep_forever, N, timeout=300
+    ).start()
+    time.sleep(3)  # let processes boot
+    t0 = time.monotonic()
+    runner.kill(1)
+    results = runner.join(raise_on_error=False, failure_grace=2.0)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60.0, "join waited toward timeout, not failure_grace"
+    assert results[1].returncode == -_sig.SIGKILL  # the injected kill
+    assert results[0].returncode is not None  # peer reaped, code recorded
+    assert not results[1].ok
+
+
 def test_fault_injection_kill_is_detected():
     runner = MultiProcessRunner(
         _target_sleep_forever, N, timeout=15

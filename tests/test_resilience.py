@@ -1,0 +1,261 @@
+"""Resilience layer: async+verified checkpointing, restore ladder, startup
+hygiene (docs/resilience.md). The chaos-driven end-to-end pins live in
+tests/test_chaos.py; these are the unit contracts."""
+
+import json
+import logging
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_guide_tpu.testing.chaos import corrupt_checkpoint
+from distributed_tensorflow_guide_tpu.train.checkpoint import (
+    Checkpointer,
+    CheckpointHook,
+    LayoutMismatchError,
+)
+from distributed_tensorflow_guide_tpu.train.hooks import StopAtStepHook
+from distributed_tensorflow_guide_tpu.train.loop import TrainLoop
+
+
+def _state(scale=1.0):
+    return {"params": jnp.full((64,), float(scale)),
+            "opt": jnp.zeros((64,))}
+
+
+# ---- async save + commit barrier -------------------------------------------
+
+
+def test_async_save_defers_manifest_to_barrier(tmp_path):
+    d = tmp_path / "ck"
+    ckpt = Checkpointer(d)
+    assert ckpt.save(1, _state(), async_=True)
+    # the commit marker must NOT exist before a barrier: an async save that
+    # looked durable before its background write finished would defeat the
+    # whole integrity contract
+    assert not (d / "manifest_1.json").exists()
+    ckpt.wait()  # the explicit barrier
+    assert (d / "manifest_1.json").exists()
+    assert ckpt.verify_step(1)
+    restored = ckpt.restore(_state(0.0))
+    np.testing.assert_array_equal(np.asarray(restored["params"]),
+                                  np.asarray(_state()["params"]))
+    ckpt.close()
+
+
+def test_async_save_commits_at_next_save(tmp_path):
+    d = tmp_path / "ck"
+    ckpt = Checkpointer(d, max_to_keep=5)
+    ckpt.save(1, _state(1), async_=True)
+    ckpt.save(2, _state(2), async_=True)  # barrier for step 1 runs first
+    assert (d / "manifest_1.json").exists()
+    ckpt.close()  # close is also a barrier: commits step 2
+    assert (d / "manifest_2.json").exists()
+
+
+def test_sync_save_commits_immediately(tmp_path):
+    d = tmp_path / "ck"
+    ckpt = Checkpointer(d)
+    ckpt.save(3, _state())
+    assert (d / "manifest_3.json").exists()
+    man = json.loads((d / "manifest_3.json").read_text())
+    assert man["step"] == 3 and man["files"]  # per-file [size, crc] entries
+    assert all(len(v) == 2 for v in man["files"].values())
+    ckpt.close()
+
+
+def test_latest_step_is_a_barrier(tmp_path):
+    ckpt = Checkpointer(tmp_path / "ck")
+    ckpt.save(4, _state(), async_=True)
+    assert ckpt.latest_step() == 4  # drains + commits the pending save
+    assert (tmp_path / "ck" / "manifest_4.json").exists()
+    ckpt.close()
+
+
+def test_async_restore_roundtrip_bitwise(tmp_path):
+    """An async-saved checkpoint restores bitwise-identical — the snapshot
+    happens at save() time, so later mutations of the live state must not
+    leak into the written checkpoint."""
+    ckpt = Checkpointer(tmp_path / "ck")
+    state = {"w": np.arange(1024, dtype=np.float32)}
+    ckpt.save(1, state, async_=True)
+    state["w"] += 777.0  # mutate AFTER save returned, BEFORE the barrier
+    restored = ckpt.restore({"w": np.zeros(1024, np.float32)})
+    np.testing.assert_array_equal(restored["w"],
+                                  np.arange(1024, dtype=np.float32))
+    ckpt.close()
+
+
+# ---- integrity manifest -----------------------------------------------------
+
+
+def test_verify_step_catches_truncation(tmp_path):
+    ckpt = Checkpointer(tmp_path / "ck")
+    ckpt.save(1, _state())
+    assert ckpt.verify_step(1)
+    corrupt_checkpoint(tmp_path / "ck", mode="truncate")
+    assert not ckpt.verify_step(1)
+    ckpt.close()
+
+
+def test_verify_step_catches_same_size_bitflip(tmp_path):
+    """A flip keeps the file size — only the CRC32 in the manifest can see
+    it. This is the case a size-only check would wave through."""
+    ckpt = Checkpointer(tmp_path / "ck")
+    ckpt.save(1, _state())
+    step, rel = corrupt_checkpoint(tmp_path / "ck", mode="flip")
+    assert (tmp_path / "ck" / "1" / rel).stat().st_size == \
+        json.loads((tmp_path / "ck" / "manifest_1.json").read_text())[
+            "files"][rel][0]
+    assert not ckpt.verify_step(1)
+    ckpt.close()
+
+
+def test_manifest_gcd_with_max_to_keep(tmp_path):
+    """Satellite: max_to_keep accounting stays correct — manifests (like
+    layout sidecars) are GC'd with their step, so a reused step number in a
+    later run can never inherit a stale manifest."""
+    d = tmp_path / "ck"
+    ckpt = Checkpointer(d, max_to_keep=2)
+    for s in (1, 2, 3, 4):
+        ckpt.save(s, _state(s))
+    assert ckpt.all_steps() == [3, 4]
+    manifests = sorted(p.name for p in d.glob("manifest_*.json"))
+    assert manifests == ["manifest_3.json", "manifest_4.json"]
+    ckpt.close()
+
+
+# ---- restore ladder ---------------------------------------------------------
+
+
+def test_restore_ladder_falls_back_and_logs_skipped(tmp_path, caplog):
+    d = tmp_path / "ck"
+    ckpt = Checkpointer(d, max_to_keep=4)
+    ckpt.save(5, _state(5))
+    ckpt.save(10, _state(10))
+    corrupt_checkpoint(d, step=10, mode="truncate")
+    with caplog.at_level(logging.WARNING, logger="dtg.train"):
+        state, step = ckpt.restore_latest_valid(_state(0))
+    assert step == 5
+    np.testing.assert_array_equal(np.asarray(state["params"]),
+                                  np.asarray(_state(5)["params"]))
+    # the fallback is logged WITH the skipped step numbers (acceptance)
+    assert any("restore ladder" in r.getMessage() and "[10]" in r.getMessage()
+               for r in caplog.records)
+    ckpt.close()
+
+
+def test_restore_ladder_all_corrupt_returns_none(tmp_path):
+    d = tmp_path / "ck"
+    ckpt = Checkpointer(d)
+    ckpt.save(5, _state(5))
+    corrupt_checkpoint(d, step=5, mode="flip")
+    assert ckpt.restore_latest_valid(_state(0)) is None
+    ckpt.close()
+
+
+def test_restore_ladder_catches_unmanifested_corruption(tmp_path):
+    """A checkpoint with no manifest (older writer) that fails to RESTORE
+    still falls down the ladder — the try/except half of the contract."""
+    d = tmp_path / "ck"
+    ckpt = Checkpointer(d, max_to_keep=4)
+    ckpt.save(5, _state(5))
+    ckpt.save(10, _state(10))
+    (d / "manifest_10.json").unlink()  # simulate a pre-manifest save
+    corrupt_checkpoint(d, step=10, mode="truncate")
+    assert ckpt.verify_step(10)  # unverifiable -> passes verification...
+    state, step = ckpt.restore_latest_valid(_state(0))  # ...restore catches
+    assert step == 5
+    ckpt.close()
+
+
+def test_restore_ladder_reraises_layout_mismatch(tmp_path):
+    """A layout mismatch is a CONFIGURATION error, not corruption: silently
+    laddering past it would restore a older checkpoint into the wrong
+    model shape story. It must raise."""
+    d = tmp_path / "ck"
+    ckpt = Checkpointer(d, default_layout={"schedule": "gpipe", "P": 2})
+    ckpt.save(1, _state())
+    ckpt.close()
+    other = Checkpointer(d, default_layout={"schedule": "1f1b", "P": 4})
+    with pytest.raises(LayoutMismatchError):
+        other.restore_latest_valid(_state(0))
+    other.close()
+
+
+def test_restore_latest_valid_empty_dir_returns_none(tmp_path):
+    ckpt = Checkpointer(tmp_path / "empty")
+    assert ckpt.restore_latest_valid(_state(0)) is None
+    ckpt.close()
+
+
+# ---- startup hygiene --------------------------------------------------------
+
+
+def test_init_cleans_stale_orbax_tmp_dirs(tmp_path):
+    """Satellite: a kill mid-save leaves a ``<step>.orbax-checkpoint-tmp-*``
+    dir (orbax's atomic-rename commit never happened) plus possibly a
+    half-written manifest tmp. A fresh Checkpointer must sweep both."""
+    d = tmp_path / "ck"
+    ckpt = Checkpointer(d)
+    ckpt.save(1, _state())
+    ckpt.close()
+    # simulate the partial write a SIGKILL mid-save leaves behind
+    tmp_dir = d / "7.orbax-checkpoint-tmp-123456"
+    (tmp_dir / "default").mkdir(parents=True)
+    (tmp_dir / "default" / "junk").write_bytes(b"\0" * 512)
+    (d / "manifest_7.json.tmp").write_text("{\"step\": 7")
+    ckpt2 = Checkpointer(d)
+    assert not tmp_dir.exists()
+    assert not (d / "manifest_7.json.tmp").exists()
+    assert sorted(ckpt2.cleaned_on_start) == [
+        "7.orbax-checkpoint-tmp-123456", "manifest_7.json.tmp"]
+    # the committed checkpoint survived the sweep and still verifies
+    assert ckpt2.latest_step() == 1 and ckpt2.verify_step(1)
+    ckpt2.close()
+
+
+def test_clean_start_reports_nothing(tmp_path):
+    ckpt = Checkpointer(tmp_path / "ck")
+    assert ckpt.cleaned_on_start == []
+    ckpt.close()
+
+
+# ---- CheckpointHook async mode ---------------------------------------------
+
+
+def _count_step(state, batch):
+    return {"w": state["w"] + batch}, {"loss": jnp.sum(state["w"])}
+
+
+def _run_hook_loop(tmpdir, async_save):
+    ckpt = Checkpointer(tmpdir, max_to_keep=10)
+    loop = TrainLoop(
+        _count_step, {"w": jnp.zeros((32,))},
+        (jnp.full((32,), float(s)) for s in range(1000)),
+        hooks=[StopAtStepHook(9),
+               CheckpointHook(ckpt, every_steps=2, async_save=async_save)],
+    )
+    final = loop.run()
+    ckpt.wait()
+    steps = ckpt.all_steps()
+    valid = [s for s in steps if ckpt.verify_step(s)]
+    restored = ckpt.restore(final, step=max(steps))
+    ckpt.close()
+    return final, steps, valid, restored
+
+
+def test_checkpoint_hook_async_parity_with_sync(tmp_path):
+    """async_save=True must change WHEN durability settles, never WHAT is
+    saved: same checkpoint labels, every save verifies, final restored
+    state bitwise-equal to the sync hook's."""
+    f_sync, steps_sync, valid_sync, r_sync = _run_hook_loop(
+        tmp_path / "sync", async_save=False)
+    f_async, steps_async, valid_async, r_async = _run_hook_loop(
+        tmp_path / "async", async_save=True)
+    assert steps_sync == steps_async == valid_sync == valid_async
+    np.testing.assert_array_equal(np.asarray(r_sync["w"]),
+                                  np.asarray(r_async["w"]))
+    np.testing.assert_array_equal(np.asarray(f_sync["w"]),
+                                  np.asarray(f_async["w"]))

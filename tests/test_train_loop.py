@@ -69,3 +69,87 @@ def test_start_step_resume_semantics():
     loop = TrainLoop(_toy_step, 0.0, _ones(), hooks=[StopAtStepHook(10)], start_step=7)
     loop.run()
     assert loop.step == 10  # resumed loops run only the remaining steps
+
+
+# ---- signal-handler restoration contract (round-10 satellite) --------------
+# TrainLoop.run promises hooks' process-wide handlers (PreemptionHook) are
+# restored on exit. Pin it for all three exit shapes: normal completion,
+# exception exit, and nested loops (an inner loop's hook must hand back the
+# outer loop's handler, not the process original).
+
+
+def _preemption_fixture(tmp_path, name):
+    from distributed_tensorflow_guide_tpu.train.checkpoint import Checkpointer
+    from distributed_tensorflow_guide_tpu.train.elastic import PreemptionHook
+
+    ckpt = Checkpointer(tmp_path / name)
+    return ckpt, PreemptionHook(ckpt)
+
+
+def test_signal_handler_restored_on_normal_exit(tmp_path):
+    import signal
+
+    original = signal.getsignal(signal.SIGTERM)
+    ckpt, hook = _preemption_fixture(tmp_path, "normal")
+    TrainLoop(_toy_step, 0.0, _ones(),
+              hooks=[StopAtStepHook(3), hook]).run()
+    assert signal.getsignal(signal.SIGTERM) == original
+    ckpt.close()
+
+
+def test_signal_handler_restored_on_exception_exit(tmp_path):
+    import signal
+
+    import pytest
+
+    original = signal.getsignal(signal.SIGTERM)
+    ckpt, hook = _preemption_fixture(tmp_path, "crash")
+
+    def boom(state, batch):
+        if state >= 2.0:
+            raise ValueError("mid-run crash")
+        return _toy_step(state, batch)
+
+    with pytest.raises(ValueError, match="mid-run crash"):
+        TrainLoop(boom, 0.0, _ones(), hooks=[hook]).run()
+    # the flag-only handler is gone even though end() never ran
+    assert signal.getsignal(signal.SIGTERM) == original
+    ckpt.close()
+
+
+def test_signal_handler_restored_across_nested_loops(tmp_path):
+    """An inner TrainLoop (e.g. a mid-training eval/fine-tune phase driven
+    from a hook or from the step path) installs its own PreemptionHook:
+    while it runs, ITS handler is live; when it exits, the OUTER loop's
+    handler must be back (not the process original); when the outer loop
+    exits, the process original is back."""
+    import signal
+
+    original = signal.getsignal(signal.SIGTERM)
+    ckpt_o, outer_hook = _preemption_fixture(tmp_path, "outer")
+    ckpt_i, inner_hook = _preemption_fixture(tmp_path, "inner")
+    seen = {}
+
+    def outer_step(state, batch):
+        if state == 1.0 and "during_inner" not in seen:
+            outer_handler = signal.getsignal(signal.SIGTERM)
+
+            def inner_step(s, b):
+                seen["during_inner"] = signal.getsignal(signal.SIGTERM)
+                return _toy_step(s, b)
+
+            TrainLoop(inner_step, 0.0, _ones(),
+                      hooks=[StopAtStepHook(2), inner_hook]).run()
+            seen["after_inner"] = signal.getsignal(signal.SIGTERM)
+            # inner exit restored the OUTER hook's handler exactly
+            assert seen["after_inner"] == outer_handler
+        return _toy_step(state, batch)
+
+    TrainLoop(outer_step, 0.0, _ones(),
+              hooks=[StopAtStepHook(4), outer_hook]).run()
+    # the inner loop really ran under its own handler, distinct from outer's
+    assert seen["during_inner"] == inner_hook._on_signal
+    assert seen["after_inner"] == outer_hook._on_signal
+    assert signal.getsignal(signal.SIGTERM) == original
+    ckpt_o.close()
+    ckpt_i.close()

@@ -1,0 +1,222 @@
+"""Watchdog: deadlines that convert stalls into prompt, diagnosable
+failures (utils/watchdog.py) — plus the TrainLoop and prefetch wiring."""
+
+import time
+
+import pytest
+
+from distributed_tensorflow_guide_tpu.utils.watchdog import (
+    DataStallError,
+    TripInfo,
+    Watchdog,
+    WatchdogTimeout,
+)
+
+
+def test_no_trip_within_deadline():
+    with Watchdog(poll_s=0.005) as wd:
+        wd.arm("quick work", 5.0)
+        time.sleep(0.02)
+        wd.disarm()
+        wd.check()  # no raise
+    assert wd.tripped is None
+
+
+def test_trip_records_and_check_raises():
+    trips = []
+    with Watchdog(action=trips.append, poll_s=0.005) as wd:
+        wd.arm("slow work", 0.03)
+        time.sleep(0.15)
+        with pytest.raises(WatchdogTimeout, match="slow work"):
+            wd.check()
+    assert len(trips) == 1 and isinstance(trips[0], TripInfo)
+    assert trips[0].tag == "slow work" and trips[0].waited_s >= 0.03
+
+
+def test_rearm_clears_previous_trip():
+    with Watchdog(action=lambda info: None, poll_s=0.005) as wd:
+        wd.arm("a", 0.02)
+        time.sleep(0.1)
+        assert wd.tripped is not None
+        wd.arm("b", 5.0)  # a fresh guard must not inherit the stale trip
+        wd.disarm()
+        wd.check()
+
+
+def test_diagnostics_dump_written(tmp_path):
+    diag = tmp_path / "stacks.txt"
+    with Watchdog(action=lambda info: None, diag_path=diag,
+                  poll_s=0.005) as wd:
+        wd.arm("stuck section", 0.02)
+        time.sleep(0.1)
+    text = diag.read_text()
+    assert "stuck section" in text
+    assert "Thread" in text or "File" in text  # faulthandler stack content
+
+
+def test_interrupt_action_breaks_python_stall():
+    """The default action interrupts the MAIN thread mid-Python-stall —
+    the caller's except KeyboardInterrupt + check() converts it."""
+    with Watchdog(poll_s=0.005) as wd:
+        wd.arm("stall", 0.05)
+        with pytest.raises((KeyboardInterrupt, WatchdogTimeout)):
+            try:
+                for _ in range(1000):
+                    time.sleep(0.01)
+            except KeyboardInterrupt:
+                wd.check()  # converts to the clean error
+                raise  # pragma: no cover - check always raises here
+        wd.disarm()
+
+
+def test_invalid_action_and_deadline_rejected():
+    with pytest.raises(ValueError, match="action"):
+        Watchdog(action="detonate")
+    with Watchdog(poll_s=0.005) as wd:
+        with pytest.raises(ValueError, match="deadline"):
+            wd.arm("x", 0.0)
+
+
+# ---- TrainLoop wiring -------------------------------------------------------
+
+
+def _toy_step(state, batch):
+    return state + batch, {"loss": state}
+
+
+def test_train_loop_data_deadline_converts_stall():
+    """A stalled data iterator becomes a WatchdogTimeout — a RECOVERABLE
+    RuntimeError run_with_recovery treats like any crash — instead of
+    hanging to the supervisor's full wall-clock timeout."""
+    from distributed_tensorflow_guide_tpu.train.loop import TrainLoop
+
+    def stalling_data():
+        yield 1.0
+        while True:  # Python-level stall, the watchdog's documented prey
+            time.sleep(0.01)
+
+    loop = TrainLoop(_toy_step, 0.0, stalling_data(), data_deadline_s=0.2)
+    with pytest.raises(WatchdogTimeout, match="data iterator"):
+        loop.run()
+    assert loop.step == 1  # the good batch ran; the stall was converted
+
+
+def test_train_loop_step_deadline_converts_slow_hook():
+    """The step guard covers dispatch + hook fan-out (where a wedged device
+    surfaces as a blocking metric read)."""
+    from distributed_tensorflow_guide_tpu.train.hooks import BaseHook
+    from distributed_tensorflow_guide_tpu.train.loop import TrainLoop
+
+    class StuckHook(BaseHook):
+        def after_step(self, step, metrics):
+            if step == 2:
+                while True:
+                    time.sleep(0.01)
+
+    loop = TrainLoop(_toy_step, 0.0, iter([1.0] * 100), hooks=[StuckHook()],
+                     step_deadline_s=0.2)
+    with pytest.raises(WatchdogTimeout, match="train step"):
+        loop.run()
+
+
+def test_train_loop_without_deadlines_has_no_watchdog():
+    from distributed_tensorflow_guide_tpu.train.loop import TrainLoop
+
+    loop = TrainLoop(_toy_step, 0.0, iter([1.0] * 3))
+    assert loop.run() == 3.0  # no watchdog machinery engaged at all
+
+
+def test_train_loop_deadline_not_tripped_by_fast_steps():
+    from distributed_tensorflow_guide_tpu.train.loop import TrainLoop
+
+    loop = TrainLoop(_toy_step, 0.0, iter([1.0] * 20),
+                     step_deadline_s=5.0, data_deadline_s=5.0)
+    assert loop.run() == 20.0 and loop.step == 20
+
+
+# ---- prefetch wiring --------------------------------------------------------
+
+
+def test_prefetch_max_host_wait_raises_data_stall():
+    from distributed_tensorflow_guide_tpu.data.prefetch import (
+        DevicePrefetchIterator,
+    )
+
+    def slow_source():
+        yield {"x": 1.0}
+        time.sleep(0.3)
+        yield {"x": 2.0}
+
+    it = DevicePrefetchIterator(slow_source(), depth=1, put_fn=lambda b: b,
+                                max_host_wait_s=0.05)
+    # the eager refill (the line that buys the overlap) fetches batch 2
+    # inside the FIRST next(), so the stall surfaces there — fail-fast
+    # means the error preempts the buffered batch
+    with pytest.raises(DataStallError, match="max_host_wait_s"):
+        next(it)
+
+
+def test_prefetch_stats_track_max_single_wait():
+    from distributed_tensorflow_guide_tpu.data.prefetch import (
+        DevicePrefetchIterator,
+    )
+
+    def source():
+        yield {"x": 1.0}
+        time.sleep(0.1)
+        yield {"x": 2.0}
+
+    it = DevicePrefetchIterator(source(), depth=1, put_fn=lambda b: b)
+    list(it)
+    assert it.stats.max_host_wait_s >= 0.1
+    assert "prefetch_max_host_wait_s" in it.stats.as_dict()
+
+
+def test_prefetch_rejects_bad_deadline():
+    from distributed_tensorflow_guide_tpu.data.prefetch import (
+        DevicePrefetchIterator,
+    )
+
+    with pytest.raises(ValueError, match="max_host_wait_s"):
+        DevicePrefetchIterator(iter([]), max_host_wait_s=0.0)
+
+
+# ---- coordinator-init retry (core/dist.py) ---------------------------------
+
+
+def test_retry_with_backoff_retries_then_succeeds():
+    from distributed_tensorflow_guide_tpu.core.dist import retry_with_backoff
+
+    calls, delays = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("coordinator not up yet")
+        return "connected"
+
+    out = retry_with_backoff(flaky, attempts=4, base_delay_s=1.0,
+                             sleep=delays.append, what="handshake")
+    assert out == "connected" and len(calls) == 3
+    assert delays == [1.0, 2.0]  # exponential, deterministic
+
+
+def test_retry_with_backoff_exhausts_and_reraises():
+    from distributed_tensorflow_guide_tpu.core.dist import retry_with_backoff
+
+    delays = []
+    with pytest.raises(RuntimeError, match="still down"):
+        retry_with_backoff(
+            lambda: (_ for _ in ()).throw(RuntimeError("still down")),
+            attempts=3, base_delay_s=0.5, max_delay_s=0.75,
+            sleep=delays.append,
+        )
+    assert delays == [0.5, 0.75]  # capped at max_delay_s
+
+
+def test_retry_with_backoff_does_not_catch_foreign_errors():
+    from distributed_tensorflow_guide_tpu.core.dist import retry_with_backoff
+
+    with pytest.raises(KeyError):
+        retry_with_backoff(lambda: {}["missing"], attempts=5,
+                           sleep=lambda s: None)
