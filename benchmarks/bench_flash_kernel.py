@@ -1,22 +1,27 @@
 #!/usr/bin/env python
-"""Kernel-only flash/carry microbench: per-kernel tok/s + roofline fractions.
+"""Kernel-only flash/carry/decode microbench: per-kernel tok/s + roofline
+fractions.
 
 The round-5 battery measured the flash training path at MFU 0.155 (seq
 1024) and the ring carry kernel at 0.157-0.487x of the XLA path — but only
 as whole-model aggregates, so WHICH kernel starves was invisible. This
-bench times each Pallas kernel alone (fwd, dq, dkv, ring carry-step) at
+bench times each Pallas kernel alone (fwd, dq, dkv, ring carry-step, and
+— round 11 — the serving decode-attention kernel at both cache dtypes) at
 its autotune-table blocks and reports, per kernel, tokens/sec plus the
 fraction of the chip's FLOP and HBM rooflines (models in
 ops/autotune.py: MXU flops over live causal blocks; minimal algorithmic
 bytes, so block-induced re-reads read as a LOW hbm fraction — the tuning
-signal).
+signal; the decode kernel's byte model lives in ops/decode_attention.py
+— it is bandwidth-bound by design, so ITS hbm fraction is the headline).
 
 ``--tune`` first sweeps the candidate block grid per kernel and records
 the winners into the persistent autotune table — after which every flash/
-carry call site in the package picks them up automatically.
+carry/decode call site in the package picks them up automatically.
 
 Default shape = the battery's ``gpt2_flash_seq1024`` attention geometry
-(b=1 microbatch, 12 heads, seq 1024, head_dim 64, bf16).
+(b=1 microbatch, 12 heads, seq 1024, head_dim 64, bf16); the decode rows
+reuse it as the GPT-2 cache geometry (seq = max_len), with the battery's
+``gpt2_decode`` batch.
 
 Off-TPU this prints an explicit skip line (rc=0) — kernel timings are
 meaningless in interpret mode; ``--fake-devices 1 --small`` runs the
@@ -45,7 +50,12 @@ def main() -> None:
     ap.add_argument("--non-causal", action="store_true")
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--kernels", nargs="+", default=None,
-                    help="subset of fwd/dq/dkv/carry kernels")
+                    help="subset of fwd/dq/dkv/carry/decode/decode_int8 "
+                         "kernels")
+    ap.add_argument("--decode-batch", type=int, default=8,
+                    help="batch for the decode rows (the battery's "
+                         "gpt2_decode geometry; the training-kernel rows "
+                         "keep --batch)")
     ap.add_argument("--tune", action="store_true",
                     help="sweep candidate blocks per kernel and record the "
                          "winners into the autotune table first")
@@ -90,8 +100,13 @@ def main() -> None:
     if args.small:
         b, h, s, d, iters = 1, 2, 256, 64, min(iters, 2)
 
+    from distributed_tensorflow_guide_tpu.ops import decode_attention as DA
+
+    # decode rows: same cache geometry (s = max_len), keyed on the CACHE
+    # dtype — the int8 row is the quantized-cache lever's kernel-only A/B
     names = {"fwd": "flash_fwd", "dq": "flash_dq", "dkv": "flash_dkv",
-             "carry": "carry_step"}
+             "carry": "carry_step", "decode": autotune.DECODE_KERNEL,
+             "decode_int8": autotune.DECODE_KERNEL}
     todo = args.kernels or list(names)
     unknown = set(todo) - set(names)
     if unknown:
@@ -104,6 +119,33 @@ def main() -> None:
 
     for short in todo:
         kernel = names[short]
+        if kernel == autotune.DECODE_KERNEL:
+            kdtype = jnp.int8 if short == "decode_int8" else dtype
+            kb = args.decode_batch
+            for s_t in tune_seqs:
+                # ensure_decode_tuned owns the decode key construction
+                # (causal=False, cache dtype) — the same discipline as
+                # the flash_blocks/carry_blocks lookup helpers
+                DA.ensure_decode_tuned(b=kb, h=h, s=s_t, d=d,
+                                       dtype=kdtype,
+                                       iters=max(5, iters // 4))
+            blk_k = DA.decode_blk_k_for(b=kb, h=h, s=s, d=d, dtype=kdtype)
+            fn = DA.make_decode_runner(blk_k, b=kb, h=h, s=s, d=d,
+                                       dtype=kdtype)
+            secs = autotune.measure_runner(fn, iters=iters)
+            flops = autotune.kernel_flops(
+                kernel, b=kb, h=h, s=s, d=d,
+                blocks=(autotune.DECODE_CHUNK_SUBLANES, blk_k),
+                causal=False)
+            hbm = DA.decode_kernel_hbm_bytes(b=kb, h=h, s=s, d=d,
+                                             dtype=kdtype)
+            report(f"flash_kernel_{short}", kb / secs, "tokens/sec",
+                   blk_k=blk_k, batch=kb, heads=h, seq_len=s, head_dim=d,
+                   cache_dtype=str(jnp.dtype(kdtype).name),
+                   secs_per_call=round(secs, 6),
+                   tuned=bool(args.tune and on_tpu),
+                   **roofline_extras(flops, hbm, 1, secs))
+            continue
         kw = dict(b=b, h=h, s=s, d=d, dtype=dtype)
         for s_t in tune_seqs:
             autotune.ensure_tuned(kernel, b=b, h=h, s=s_t, d=d,

@@ -3,19 +3,25 @@
 roofline-honest like every training bench.
 
 GPT-2 124M by default (--small for the CPU smoke geometry). The whole
-generate call is ONE compiled program (prefill + lax.scan decode loop), so
-the measured number includes everything a serving step pays: per-token
-attention over the cache, sampling, cache updates — but only one host
-dispatch per call.
+generate call is ONE compiled program (prefill + lax.scan decode loop, or
+the speculative draft/verify while-loop), so the measured number includes
+everything a serving step pays: per-token attention over the cache,
+sampling, cache updates — but only one host dispatch per call.
 
 Decode is bandwidth-bound: every step re-reads the full parameter set and
-the fixed-size KV cache (the round-5 verdict measured ~4% of the v5e's
-819 GB/s with nothing reporting why). The JSON line therefore carries
-``hbm_gb_per_s`` + ``hbm_roofline_frac`` from the minimal-traffic model
-(models/generation.py ``decode_hbm_bytes_per_step``: params read once +
-cache read once + one-slot write, per decode step), alongside the decode
-knobs under test: ``--unroll`` (scan unroll) and ``--no-donate`` (cache
-buffer donation off — the A/B for the in-place-cache path).
+the KV cache (the round-5 verdict measured ~4% of the v5e's 819 GB/s with
+nothing reporting why). The JSON line therefore carries ``hbm_gb_per_s`` +
+``hbm_roofline_frac`` from the minimal-traffic model
+(models/generation.py ``decode_hbm_bytes_per_step`` — cache-dtype-aware,
+and length-aware when the Pallas kernel reads only written blocks) plus
+``cache_bytes_per_step``, alongside the decode knobs under test:
+
+* ``--kv-dtype int8`` — quantized KV cache (halves the cache-read term);
+* ``--decode-impl {auto,dense,pallas}`` — the length-aware streaming
+  decode-attention kernel (``auto`` = pallas on TPU only);
+* ``--spec-draft-layers K`` — self-speculative decoding (K-layer draft
+  prefix, batched verify); emits ``accepted_tokens_per_step``;
+* ``--unroll`` (scan unroll) and ``--no-donate`` (cache donation off).
 
 Reports decode tokens/sec (new tokens x batch / time, prompt ingestion
 excluded from the token count but included in the time — conservative).
@@ -47,16 +53,34 @@ def main() -> None:
                     help="disable KV-cache buffer donation (A/B knob; the "
                          "default donates the cache into the compiled "
                          "program so updates alias in place)")
+    ap.add_argument("--kv-dtype", choices=["model", "int8"],
+                    default="model",
+                    help="KV-cache storage dtype: 'model' keeps the "
+                         "config dtype, 'int8' stores quantized values + "
+                         "per-slot f32 scales (halves the dominant "
+                         "cache-read term)")
+    ap.add_argument("--decode-impl", choices=["auto", "dense", "pallas"],
+                    default="auto",
+                    help="decode-attention impl; 'auto' = the length-"
+                         "aware Pallas kernel on TPU, dense elsewhere")
+    ap.add_argument("--spec-draft-layers", type=int, default=0,
+                    help="self-speculative decoding: draft with this many "
+                         "leading layers of the same model (0 = off)")
+    ap.add_argument("--spec-lookahead", type=int, default=4,
+                    help="drafted tokens per verify step")
     ap.add_argument("--small", action="store_true")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
     device_setup(args.fake_devices)
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from distributed_tensorflow_guide_tpu.models.generation import (
+        decode_cache_bytes_per_step,
         decode_hbm_bytes_per_step,
         make_generate_fn,
     )
@@ -65,17 +89,26 @@ def main() -> None:
         TransformerConfig,
         gpt2_124m,
     )
+    from distributed_tensorflow_guide_tpu.ops import decode_attention as DA
 
+    spec = args.spec_draft_layers > 0
+    lookahead = args.spec_lookahead if spec else 0
     if args.small:
+        # max_len rounds up to a 64-multiple so the smoke's pallas path
+        # resolves a real KV block instead of hitting the dense fallback
+        need = args.prompt_len + args.max_new + lookahead
         cfg = TransformerConfig(
             vocab_size=1024, num_layers=2, num_heads=4, d_model=128,
-            d_ff=512, max_len=args.prompt_len + args.max_new,
+            d_ff=512, max_len=-(-need // 64) * 64,
             causal=True, dtype=jnp.float32)
     else:
-        import dataclasses
-
         cfg = dataclasses.replace(
-            gpt2_124m(), max_len=max(1024, args.prompt_len + args.max_new))
+            gpt2_124m(),
+            max_len=max(1024, args.prompt_len + args.max_new + lookahead))
+    cfg = dataclasses.replace(
+        cfg,
+        kv_dtype="int8" if args.kv_dtype == "int8" else None,
+        decode_impl=args.decode_impl)
     model = Transformer(cfg)
     params = jax.jit(model.init)(
         jax.random.PRNGKey(0),
@@ -84,7 +117,9 @@ def main() -> None:
     gen = make_generate_fn(cfg, max_new_tokens=args.max_new,
                            temperature=args.temperature, top_k=args.top_k,
                            donate_cache=not args.no_donate,
-                           unroll=args.unroll)
+                           unroll=args.unroll,
+                           spec_draft_layers=args.spec_draft_layers,
+                           spec_lookahead=args.spec_lookahead)
     rng = np.random.RandomState(0)
     prompt = rng.randint(0, cfg.vocab_size,
                          (args.batch, args.prompt_len)).astype(np.int32)
@@ -98,15 +133,59 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     # decode-roofline accounting: bytes per decode step x steps executed.
-    # Per call the scan runs max_new - 1 full-cache decode steps (the
-    # prefill reads ~prompt_len cache slots, not max_len, and its traffic
-    # AND the scan's are both inside dt — so charging only the scan steps
-    # keeps the reported bandwidth conservative).
-    bytes_per_step = decode_hbm_bytes_per_step(cfg, params, args.batch)
-    decode_steps = (args.max_new - 1) * args.iters
-    roofline = (roofline_extras(None, bytes_per_step, decode_steps, dt)
-                if decode_steps > 0 else {})  # --max-new 1: no decode steps
-    extra = {}
+    # Per call the scan runs max_new - 1 decode steps (the prefill reads
+    # ~prompt_len cache slots, not max_len, and its traffic AND the scan's
+    # are both inside dt — so charging only the scan steps keeps the
+    # reported bandwidth conservative). With the length-aware Pallas
+    # kernel the per-step cache read is the BLOCK-ROUNDED live length, not
+    # max_len — the model averages it over the scan's steps so the
+    # denominator stays honest (full-cache charging is only correct for
+    # the dense static-shape path).
+    impl = cfg.resolve_decode_impl()
+    extra = {
+        "kv_dtype": args.kv_dtype,
+        "decode_impl": impl,
+    }
+    roofline = {}
+    if spec:
+        # the per-scan-step traffic model does not describe the
+        # draft/verify schedule (cache read per VERIFY step over G+1-token
+        # chunks, not per emitted token) — the speculative row's story is
+        # steps, not bytes, so no byte/roofline keys are computed at all
+        # rather than reported misleadingly equal to the continuity row
+        extra["spec_draft_layers"] = args.spec_draft_layers
+        extra["spec_lookahead"] = args.spec_lookahead
+        stats = gen.last_stats or {}
+        steps = int(stats.get("verify_steps", 0))
+        accepted = int(stats.get("accepted_drafts", 0))
+        if steps:
+            extra["accepted_tokens_per_step"] = round(accepted / steps, 3)
+            extra["spec_verify_steps"] = steps
+    else:
+        cache_dtype = jnp.int8 if cfg.kv_dtype == "int8" else cfg.dtype
+        blk_k = DA.decode_blk_k_for(b=args.batch, h=cfg.num_heads,
+                                    s=cfg.max_len, d=cfg.head_dim,
+                                    dtype=cache_dtype)
+        effective_len = None
+        if impl == "pallas" and DA.supported(cfg.max_len, blk_k):
+            # scan step i (i = 0..max_new-2) applies the token at index
+            # P+i, so the kernel's live length that step is P+i+1
+            # (block-rounded)
+            lens = [min(cfg.max_len,
+                        -(-(args.prompt_len + i + 1) // blk_k) * blk_k)
+                    for i in range(args.max_new - 1)]
+            effective_len = sum(lens) / len(lens) if lens else None
+        bytes_per_step = decode_hbm_bytes_per_step(
+            cfg, params, args.batch, effective_len=effective_len)
+        extra["hbm_bytes_per_decode_step"] = bytes_per_step
+        extra["cache_bytes_per_step"] = decode_cache_bytes_per_step(
+            cfg, args.batch, effective_len=effective_len)
+        decode_steps = (args.max_new - 1) * args.iters
+        if decode_steps > 0:  # --max-new 1: no decode steps
+            roofline = roofline_extras(None, bytes_per_step, decode_steps,
+                                       dt)
+        if effective_len is not None:
+            extra["effective_cache_len"] = round(effective_len, 1)
     if args.unroll != 1:
         extra["unroll"] = args.unroll
     if args.no_donate:
@@ -115,7 +194,6 @@ def main() -> None:
            args.batch * args.max_new * args.iters / dt, "tokens/sec",
            batch=args.batch, prompt_len=args.prompt_len,
            max_new=args.max_new,
-           hbm_bytes_per_decode_step=bytes_per_step,
            **roofline,
            **extra)
 

@@ -82,13 +82,20 @@ SMOKE = {
          "--steps", "2", "--image-size", "32", "--augment",
          "--small-model"],
     "bench_generate.py":
+        # all three round-11 decode levers at once (CPU liveness: int8
+        # quantized cache + interpret-mode Pallas decode-attend + the
+        # speculative draft/verify loop run end to end; timings
+        # meaningless — the one-variable A/B rows live in run_battery)
         ["--fake-devices", "1", "--small", "--batch", "2",
          "--prompt-len", "16", "--max-new", "8", "--iters", "2",
-         "--unroll", "2"],
+         "--unroll", "2", "--kv-dtype", "int8", "--decode-impl", "pallas",
+         "--spec-draft-layers", "1"],
     "bench_flash_kernel.py":
-        # interpret-mode liveness: every kernel (fwd/dq/dkv/carry) runs end
-        # to end and emits its roofline-model keys; timings meaningless
-        ["--fake-devices", "1", "--small"],
+        # interpret-mode liveness: every kernel (fwd/dq/dkv/carry, plus
+        # the decode kernel at both cache dtypes) runs end to end and
+        # emits its roofline-model keys; timings meaningless. The real-
+        # mode --tune decode sweep prints the skip JSON off-TPU.
+        ["--fake-devices", "1", "--small", "--decode-batch", "2"],
     "bench_fused_ce.py":
         # CPU liveness: naive + fused fwd/bwd run end to end and emit the
         # closed-form traffic keys; timings meaningless (off-TPU skip-JSON

@@ -115,10 +115,33 @@ BATTERY: list[tuple[str, list[str], int]] = [
     ("fsdp_prefetch",
      ["benchmarks/bench_comm_overlap.py", "--mode", "fsdp",
       "--fsdp-prefetch", "on"], 1800),
-    ("gpt2_decode", ["benchmarks/bench_generate.py"], 1800),
+    # decode continuity row (round 11): pins ALL THREE new levers off
+    # explicitly — decode_impl="auto" resolves to the Pallas kernel on TPU
+    # and letting it (or int8 / speculative) flip would silently move the
+    # number of record (the round-7 one-variable lesson). Each lever row
+    # below is argv-identical except its one knob. The decode-kernel
+    # --tune sweep runs in flash_kernel_roofline ABOVE (it covers the
+    # decode_attend key at both cache dtypes), so these rows pick up the
+    # tuned KV block.
+    ("gpt2_decode",
+     ["benchmarks/bench_generate.py", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--spec-draft-layers", "0"], 1800),
     # decode-roofline A/B: scan unroll (the donation default is already on)
     ("gpt2_decode_unroll4",
-     ["benchmarks/bench_generate.py", "--unroll", "4"], 1800),
+     ["benchmarks/bench_generate.py", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--spec-draft-layers", "0",
+      "--unroll", "4"], 1800),
+    # one-variable lever rows vs the continuity row: quantized cache,
+    # length-aware Pallas decode-attend, self-speculative decoding
+    ("gpt2_decode_kv_int8",
+     ["benchmarks/bench_generate.py", "--kv-dtype", "int8",
+      "--decode-impl", "dense", "--spec-draft-layers", "0"], 1800),
+    ("gpt2_decode_pallas",
+     ["benchmarks/bench_generate.py", "--kv-dtype", "model",
+      "--decode-impl", "pallas", "--spec-draft-layers", "0"], 1800),
+    ("gpt2_decode_spec",
+     ["benchmarks/bench_generate.py", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--spec-draft-layers", "4"], 1800),
     ("ring_attention_1024",
      ["benchmarks/bench_ring_attention.py", "--seq-len", "1024"], 1500),
     ("ring_attention_2048",

@@ -16,7 +16,15 @@ this is capability the TPU build adds on top of parity. TPU-first shape:
   with C = 1 (models/transformer.py ``_decode_attend``).
 * Sampling: greedy (``temperature=0``), temperature, and top-k — all
   branchless (top-k via ``lax.top_k`` threshold masking) so the scan body
-  stays a single fused program.
+  stays a single fused program. Keys derive from the absolute position
+  (``fold_in(rng, position)``), which is what lets speculative decoding
+  reproduce the vanilla stream token-for-token.
+* Decode bandwidth levers (round 11): ``cfg.kv_dtype="int8"`` (quantized
+  cache, fused dequant), ``cfg.decode_impl`` (length-aware Pallas
+  decode-attention — ops/decode_attention.py), and
+  ``spec_draft_layers``/``spec_lookahead`` (self-speculative decoding —
+  see :func:`make_generate_fn`). docs/serving.md "Decode levers" covers
+  when each pays.
 
 Decode-mode parity with the training forward is pinned by
 tests/test_generation.py (prefill logits == full-forward logits; greedy
@@ -68,15 +76,47 @@ def init_cache(cfg: TransformerConfig, params, batch_size: int):
     return cache
 
 
+def decode_cache_bytes_per_step(cfg: TransformerConfig, batch_size: int, *,
+                                effective_len: int | None = None) -> float:
+    """KV-cache HBM traffic of ONE decode step: ``effective_len`` slots
+    read (K and V, at the CACHE dtype — 1 byte under ``kv_dtype="int8"`` —
+    plus the two per-slot f32 scales when quantized) and one slot written.
+
+    ``effective_len=None`` models the dense static-shape path, which
+    attends against all ``max_len`` slots every step. The length-aware
+    Pallas kernel (``decode_impl="pallas"``) reads only written blocks, so
+    its caller passes the block-rounded live length — charging it the full
+    cache would overstate its achieved bandwidth and flatter the roofline
+    fraction the ≥0.4 gate judges."""
+    import jax.numpy as _jnp
+
+    from distributed_tensorflow_guide_tpu.ops.decode_attention import (
+        cache_slot_bytes,
+    )
+
+    length = cfg.max_len if effective_len is None else min(
+        int(effective_len), cfg.max_len)
+    kv_dtype = _jnp.int8 if cfg.kv_dtype == "int8" else cfg.dtype
+    # bytes per (batch, slot): K + V vectors across heads (+ scales when
+    # quantized) — the shared per-(slot, head) definition, so this model
+    # and the kernel-only bench's can never disagree on the same cache
+    per_slot = cfg.num_heads * cache_slot_bytes(cfg.head_dim, kv_dtype)
+    read = cfg.num_layers * batch_size * length * per_slot
+    write = cfg.num_layers * batch_size * per_slot  # one slot
+    return float(read + write)
+
+
 def decode_hbm_bytes_per_step(cfg: TransformerConfig, params,
-                              batch_size: int) -> float:
+                              batch_size: int, *,
+                              effective_len: int | None = None) -> float:
     """Minimal algorithmic HBM traffic of ONE decode step: every
     NON-EMBEDDING parameter read once (the embedding tables are gathered,
     not streamed — a step touches B rows of the token table and one
     position row, not the ~154 MB table; counting it whole would inflate
-    the roofline fraction the ≥0.4 acceptance gate judges), the full
-    fixed-size KV cache read once (static-shape attention attends against
-    all ``max_len`` slots every step), plus the one-token cache write.
+    the roofline fraction the ≥0.4 acceptance gate judges), plus the
+    cache-dtype-aware KV traffic of :func:`decode_cache_bytes_per_step`
+    (full ``max_len`` read for the dense path; pass ``effective_len`` for
+    the length-aware kernel so the denominator stays honest either way).
     Decode is bandwidth-bound — this is the roofline denominator
     ``benchmarks/bench_generate.py`` reports ``hbm_gb_per_s`` against.
     ``params`` may be arrays or the eval_shape tree (sizes/dtypes only)."""
@@ -95,35 +135,45 @@ def decode_hbm_bytes_per_step(cfg: TransformerConfig, params,
                 it = np.dtype(leaf.dtype).itemsize
                 emb_bytes += leaf.size * it
                 gathered += rows * leaf.shape[-1] * it
-    item = np.dtype(cfg.dtype).itemsize
-    kv_slots = (batch_size * cfg.max_len * cfg.num_heads * cfg.head_dim
-                * item * 2)  # k and v
-    cache_read = cfg.num_layers * kv_slots
-    cache_write = cfg.num_layers * kv_slots // cfg.max_len  # one slot
-    return float(p_bytes - emb_bytes + gathered + cache_read + cache_write)
+    cache = decode_cache_bytes_per_step(cfg, batch_size,
+                                        effective_len=effective_len)
+    return float(p_bytes - emb_bytes + gathered + cache)
 
 
-def _sample(logits, rng, temperature: float, top_k: int | None):
+def _sample(logits, key, temperature: float, top_k: int | None):
     """(B, V) logits -> (B,) int32 token ids. Branchless; greedy when
-    temperature == 0 (exact argmax, not a limit)."""
+    temperature == 0 (exact argmax, not a limit).
+
+    ``key`` is the POSITION-derived key ``fold_in(rng, position)`` — not a
+    split chain. Deriving the key from the absolute sequence position makes
+    the sampled stream a pure function of (rng, position, logits), which is
+    what lets speculative decoding reproduce the vanilla stream exactly:
+    the draft and the verifier sample position p with the SAME key, so a
+    draft whose logits agree with the full model yields the same token
+    (the Gumbel coupling behind the accept test), and every accepted token
+    is bitwise the one vanilla decoding would have emitted."""
     if temperature == 0.0:
         return jnp.argmax(logits, -1).astype(jnp.int32)
     logits = logits / temperature
     if top_k is not None:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    return jax.random.categorical(rng, logits).astype(jnp.int32)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
 def make_generate_fn(cfg: TransformerConfig, *, max_new_tokens: int,
                      temperature: float = 1.0, top_k: int | None = None,
-                     donate_cache: bool = True, unroll: int = 1):
+                     donate_cache: bool = True, unroll: int = 1,
+                     spec_draft_layers: int = 0, spec_lookahead: int = 4):
     """Build a jitted ``(params, prompt (B, P) int32, rng) -> (B, P + N)``
-    generator. Compiles once per (B, P) shape; P + max_new_tokens must fit
-    ``cfg.max_len`` (checked eagerly per call).
+    generator. Compiles once per (B, P) shape; P + max_new_tokens (+ the
+    speculative lookahead, when on) must fit ``cfg.max_len`` (checked
+    eagerly per call).
 
     Decode-path knobs (the HBM-roofline levers — decode is bandwidth-bound:
-    every step re-reads the params and the KV cache):
+    every step re-reads the params and the KV cache; ``cfg.kv_dtype`` and
+    ``cfg.decode_impl`` attack the cache bytes, the knobs here attack the
+    steps):
 
     * ``donate_cache`` (default True): the cache is allocated OUTSIDE the
       compiled program and donated into it, so XLA aliases the buffers and
@@ -136,39 +186,150 @@ def make_generate_fn(cfg: TransformerConfig, *, max_new_tokens: int,
     * ``unroll``: ``lax.scan`` unroll factor for the decode loop — trades
       program size for per-token loop/dispatch overhead; parity is pinned
       (the unrolled loop is the same program repeated).
+    * ``spec_draft_layers`` (K > 0 turns speculative decoding on): draft
+      with the K-layer PREFIX of the same model — shared params (flax
+      ignores the unused deeper blocks), its own small K-layer cache —
+      then verify all ``spec_lookahead`` drafted tokens in ONE full-model
+      forward (a (G+1)-token chunk through the same ``_decode_attend``
+      path) and accept the longest matching prefix, batch-lockstep (the
+      accept count is the min over rows, which keeps the cache write index
+      a scalar and every shape static). Sampling keys derive from the
+      absolute position (see ``_sample``), so the emitted stream is the
+      vanilla stream exactly: every accepted token is the verifier's own
+      token for that position, and on the first mismatch the verifier's
+      token is emitted instead — greedy speculative output is pinned
+      BITWISE-identical to vanilla greedy (it is a reordering of the same
+      argmaxes; tests/test_generation.py pins the sampled mode too). The
+      outer accept loop is a ``lax.while_loop`` (static shapes, dynamic
+      trip count — no wasted verify passes after the budget is met);
+      rejected slots hold stale k/v but are ALWAYS rewritten by the next
+      draft/verify chunk before any later query can attend to them.
+      Per-call acceptance stats land in ``generate.last_stats``.
     """
     dcfg = decode_config(cfg)
     model = Transformer(dcfg)
     sample = partial(_sample, temperature=temperature, top_k=top_k)
+    spec = spec_draft_layers > 0
+    if spec and not 0 < spec_draft_layers < cfg.num_layers:
+        raise ValueError(
+            f"spec_draft_layers {spec_draft_layers} must lie strictly "
+            f"between 0 and num_layers {cfg.num_layers} (the draft is a "
+            "proper prefix of the same model)")
+    if spec and spec_lookahead < 1:
+        raise ValueError(f"spec_lookahead {spec_lookahead} must be >= 1")
+    if spec:
+        draft_cfg = dataclasses.replace(cfg, num_layers=spec_draft_layers)
+        draft_model = Transformer(decode_config(draft_cfg))
 
     def _generate(params, prompt, cache, rng):
         B, P = prompt.shape
         # prefill: the whole prompt in one forward pass, cache filled
         logits, vs = model.apply({"params": params, "cache": cache},
                                  prompt, 0, mutable=["cache"])
-        rng, sub = jax.random.split(rng)
-        tok = sample(logits[:, -1], sub)
+        tok = sample(logits[:, -1], jax.random.fold_in(rng, P))
 
         def body(carry, _):
-            cache, tok, idx, rng = carry
+            cache, tok, idx = carry
             logits, vs = model.apply({"params": params, "cache": cache},
                                      tok[:, None], idx, mutable=["cache"])
-            rng, sub = jax.random.split(rng)
-            nxt = sample(logits[:, -1], sub)
-            return (vs["cache"], nxt, idx + 1, rng), tok
+            nxt = sample(logits[:, -1], jax.random.fold_in(rng, idx + 1))
+            return (vs["cache"], nxt, idx + 1), tok
 
-        (_, last, _, _), toks = lax.scan(
-            body, (vs["cache"], tok, jnp.int32(P), rng), None,
+        (_, last, _), toks = lax.scan(
+            body, (vs["cache"], tok, jnp.int32(P)), None,
             length=max_new_tokens - 1, unroll=unroll)
         new = jnp.concatenate([toks.T, last[:, None]], axis=1)  # (B, N)
         return jnp.concatenate([prompt, new], axis=1)
+
+    def _generate_spec(params, prompt, cache, draft_cache, rng):
+        B, P = prompt.shape
+        G = spec_lookahead
+        # prefill BOTH caches with the prompt; the first token comes from
+        # the full model, exactly as in the vanilla path
+        logits, vs = model.apply({"params": params, "cache": cache},
+                                 prompt, 0, mutable=["cache"])
+        cache = vs["cache"]
+        _, dvs = draft_model.apply(
+            {"params": params, "cache": draft_cache}, prompt, 0,
+            mutable=["cache"])
+        draft_cache = dvs["cache"]
+        t0 = sample(logits[:, -1], jax.random.fold_in(rng, P))
+        # emitted-token buffer, G slots of slack: one verify chunk may
+        # emit up to G+1 tokens and the loop exits as soon as the budget
+        # is met — overshoot is sliced off below
+        buf = jnp.zeros((B, max_new_tokens + G), jnp.int32)
+        buf = lax.dynamic_update_slice(buf, t0[:, None], (0, 0))
+
+        def cond(carry):
+            return carry[4] < max_new_tokens
+
+        def body(carry):
+            cache, draft_cache, buf, last, produced, steps, accepted = carry
+            idx0 = P + produced - 1  # position of `last` (k/v unwritten)
+
+            def draft_body(dc, _):
+                draft_cache, tok, idx = dc
+                dl, dvs = draft_model.apply(
+                    {"params": params, "cache": draft_cache}, tok[:, None],
+                    idx, mutable=["cache"])
+                nxt = sample(dl[:, -1], jax.random.fold_in(rng, idx + 1))
+                return (dvs["cache"], nxt, idx + 1), nxt
+
+            # G+1 steps, last output discarded: the extra step exists to
+            # WRITE the draft-cache slot of the final draft (position
+            # idx0+G). Without it a fully-accepted round (m == G) jumps
+            # past that slot forever and every later draft attends a
+            # zero-initialized k/v hole — output would stay correct (the
+            # verifier is authoritative) but the draft stream would drift
+            # from the true K-layer model and acceptance would decay in
+            # exactly the high-acceptance regime the lever exists for.
+            (draft_cache, _, _), drafts = lax.scan(
+                draft_body, (draft_cache, last, idx0), None, length=G + 1,
+                unroll=unroll)
+            drafts = jnp.moveaxis(drafts[:G], 0, 1)  # (B, G)
+            # verify: one (G+1)-token chunk through the FULL model — its
+            # row j scores position idx0+j+1; the same position-derived
+            # key as the draft makes the accept test a pure token match
+            chunk = jnp.concatenate([last[:, None], drafts], axis=1)
+            vl, vvs = model.apply({"params": params, "cache": cache},
+                                  chunk, idx0, mutable=["cache"])
+            cache = vvs["cache"]
+            verified = jnp.stack(
+                [sample(vl[:, j], jax.random.fold_in(rng, idx0 + 1 + j))
+                 for j in range(G + 1)], axis=1)  # (B, G+1)
+            matches = (verified[:, :G] == drafts).astype(jnp.int32)
+            # longest accepted prefix per row, then batch-lockstep min so
+            # the cache index stays a scalar
+            m = jnp.min(jnp.sum(jnp.cumprod(matches, axis=1), axis=1))
+            # emit the verifier's tokens 0..m: positions j < m equal the
+            # drafts (that is what accepted means) and position m is the
+            # verifier's correction/bonus — all are exactly what vanilla
+            # decode would emit. Columns past m are garbage conditioned on
+            # rejected drafts; they are overwritten by the next chunk
+            # before the slice below can see them.
+            buf = lax.dynamic_update_slice(buf, verified, (0, produced))
+            last = lax.dynamic_index_in_dim(verified, m, axis=1,
+                                            keepdims=False)
+            return (cache, draft_cache, buf, last, produced + m + 1,
+                    steps + 1, accepted + m)
+
+        init = (cache, draft_cache, buf, t0, jnp.int32(1), jnp.int32(0),
+                jnp.int32(0))
+        _, _, buf, _, produced, steps, accepted = lax.while_loop(
+            cond, body, init)
+        out = jnp.concatenate([prompt, buf[:, :max_new_tokens]], axis=1)
+        return out, steps, accepted
 
     # Donation is a no-op the CPU backend additionally WARNS about
     # ("donated buffers were not usable"), so the knob is gated off there
     # — the fresh-cache-per-call safety contract is backend-independent
     # and stays tested either way.
     donate = donate_cache and jax.default_backend() != "cpu"
-    jitted = jax.jit(_generate, donate_argnums=(2,) if donate else ())
+    if spec:
+        jitted = jax.jit(_generate_spec,
+                         donate_argnums=(2, 3) if donate else ())
+    else:
+        jitted = jax.jit(_generate, donate_argnums=(2,) if donate else ())
 
     # The cache SHAPE tree is a full Flax module trace — far too expensive
     # to re-derive inside the per-call serving path (it would sit in every
@@ -179,17 +340,35 @@ def make_generate_fn(cfg: TransformerConfig, *, max_new_tokens: int,
     def _cache_shapes(batch_size: int):
         return cache_shapes(cfg, batch_size)
 
+    @lru_cache(maxsize=8)
+    def _draft_cache_shapes(batch_size: int):
+        return cache_shapes(draft_cfg, batch_size)
+
+    def _fresh(shapes):
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
     def generate(params, prompt, rng):
         B, P = prompt.shape
-        if P + max_new_tokens > dcfg.max_len:
+        budget = max_new_tokens + (spec_lookahead if spec else 0)
+        if P + budget > dcfg.max_len:
             raise ValueError(
-                f"prompt {P} + max_new_tokens {max_new_tokens} exceeds "
-                f"max_len {dcfg.max_len}")
-        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
-                             _cache_shapes(B))
-        return jitted(params, prompt, cache, rng)
+                f"prompt {P} + max_new_tokens {max_new_tokens}"
+                + (f" + spec_lookahead {spec_lookahead}" if spec else "")
+                + f" exceeds max_len {dcfg.max_len}")
+        cache = _fresh(_cache_shapes(B))
+        if not spec:
+            return jitted(params, prompt, cache, rng)
+        draft_cache = _fresh(_draft_cache_shapes(B))
+        out, steps, accepted = jitted(params, prompt, cache, draft_cache,
+                                      rng)
+        # raw device scalars — reading them synchronizes, so benches fetch
+        # AFTER the timed region
+        generate.last_stats = {"verify_steps": steps,
+                               "accepted_drafts": accepted}
+        return out
 
     # introspection for tests/benches: whether the compiled program
     # actually aliases the cache argument (False on the CPU backend)
     generate.donates_cache = donate
+    generate.last_stats = None
     return generate

@@ -88,12 +88,39 @@ class TransformerConfig:
     # static shapes throughout — the lax.scan decode loop compiles once.
     # False (default) leaves the training path byte-identical.
     decode: bool = False
+    # KV-cache storage dtype (decode mode only). None keeps the cache at
+    # ``dtype``; "int8" stores cached_key/cached_value as int8 with
+    # per-slot-per-head f32 scales (key_scale/value_scale in the cache
+    # collection) and folds dequantization into _decode_attend's QK^T and
+    # AV contractions — halving the dominant cache-read term of the
+    # bandwidth-bound decode step (ops/decode_attention.quantize_kv).
+    kv_dtype: str | None = None
+    # Decode-attention implementation (decode mode only):
+    # "dense"  — XLA softmax attention over the full fixed-size cache (the
+    #            historical path; the only one that keeps the legacy
+    #            (B, S, H, hd) cache layout when kv_dtype is None).
+    # "pallas" — length-aware streaming kernel (ops/decode_attention.py):
+    #            reads only written cache blocks, consumes int8 + scales
+    #            natively, blocks resolved from the autotune table. The
+    #            cache lives in kernel layout (B, H, S, hd).
+    # "auto"   — pallas on TPU, dense elsewhere (the flash/ring TPU-only
+    #            convention; CPU tier-1 traces stay byte-identical).
+    decode_impl: str = "auto"
 
     def __post_init__(self):
         if self.attn_impl not in ("auto", "dense", "flash"):
             raise ValueError(
                 "attn_impl must be 'auto', 'dense' or 'flash', "
                 f"got {self.attn_impl!r}"
+            )
+        if self.decode_impl not in ("auto", "dense", "pallas"):
+            raise ValueError(
+                "decode_impl must be 'auto', 'dense' or 'pallas', "
+                f"got {self.decode_impl!r}"
+            )
+        if self.kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {self.kv_dtype!r}"
             )
         if self.remat_mode not in (None, "none", "attention", "block"):
             raise ValueError(
@@ -108,6 +135,16 @@ class TransformerConfig:
         if self.remat_mode is not None:
             return self.remat_mode
         return "block" if self.remat else "none"
+
+    def resolve_decode_impl(self) -> str:
+        """Resolve the decode-attention impl: 'auto' is pallas on TPU and
+        dense everywhere else (same backend-resolution convention as the
+        ring kernel and the KV-cache donation gate)."""
+        if self.decode_impl != "auto":
+            return self.decode_impl
+        import jax
+
+        return "pallas" if jax.default_backend() == "tpu" else "dense"
 
     def resolve_attn_impl(self, seq_len: int | None = None) -> str:
         """Resolve 'auto' against the actual (trace-time) sequence length;
@@ -245,33 +282,129 @@ class MultiHeadAttention(nn.Module):
         """KV-cache incremental attention over a (B, C, H, hd) chunk.
 
         Writes the chunk's k/v at cache positions [index, index+C) and
-        attends q against the full fixed-size cache under the mask
-        ``key_pos <= q_pos`` — which simultaneously enforces causality
-        within the chunk AND hides every not-yet-written cache slot (a
-        slot is written only once its position has been reached), so
-        one code path serves prefill (C = prompt length) and decode
-        (C = 1) with fully static shapes.
+        attends q against the cache under the mask ``key_pos <= q_pos`` —
+        which simultaneously enforces causality within the chunk AND hides
+        every not-yet-written cache slot (a slot is written only once its
+        position has been reached), so one code path serves prefill
+        (C = prompt length) and decode (C = 1) with fully static shapes.
+
+        Two bandwidth levers hang off the config (decode is HBM-bound —
+        the cache read dominates the step): ``kv_dtype="int8"`` stores the
+        cache quantized with per-slot-per-head f32 scales and folds
+        dequantization into the two contractions; ``decode_impl`` selects
+        the length-aware Pallas streaming kernel
+        (ops/decode_attention.py) over the dense full-cache read. The
+        default (dense, unquantized) path is byte-identical to the
+        historical trace — the tier-1 hermeticity pin in
+        tests/test_generation.py. Any non-default lever moves the cache
+        to the kernel layout (B, H, max_len, hd) so the Pallas path never
+        pays a per-step cache transpose.
         """
         cfg = self.cfg
         if index is None:
             raise ValueError("cfg.decode=True requires the write index")
         B, C, h, hd = q.shape
+        quantized = cfg.kv_dtype == "int8"
+        impl = cfg.resolve_decode_impl()
+        if not quantized and impl == "dense":
+            # the historical path, kept verbatim (hermeticity pin)
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (B, cfg.max_len, h, hd), cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (B, cfg.max_len, h, hd), cfg.dtype)
+            ck.value = lax.dynamic_update_slice(ck.value, k,
+                                                (0, index, 0, 0))
+            cv.value = lax.dynamic_update_slice(cv.value, v,
+                                                (0, index, 0, 0))
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) / jnp.sqrt(
+                hd).astype(cfg.dtype)
+            q_pos = index + jnp.arange(C)
+            k_pos = jnp.arange(cfg.max_len)
+            mask = k_pos[None, :] <= q_pos[:, None]  # (C, max_len)
+            scores = jnp.where(mask[None, None], scores,
+                               jnp.finfo(cfg.dtype).min)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(
+                cfg.dtype)
+            return jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
+
+        from distributed_tensorflow_guide_tpu.ops import (
+            decode_attention as DA,
+        )
+
+        cache_dtype = jnp.int8 if quantized else cfg.dtype
         ck = self.variable("cache", "cached_key", jnp.zeros,
-                           (B, cfg.max_len, h, hd), cfg.dtype)
+                           (B, h, cfg.max_len, hd), cache_dtype)
         cv = self.variable("cache", "cached_value", jnp.zeros,
-                           (B, cfg.max_len, h, hd), cfg.dtype)
-        ck.value = lax.dynamic_update_slice(ck.value, k, (0, index, 0, 0))
-        cv.value = lax.dynamic_update_slice(cv.value, v, (0, index, 0, 0))
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) / jnp.sqrt(
+                           (B, h, cfg.max_len, hd), cache_dtype)
+        kT = jnp.transpose(k, (0, 2, 1, 3))  # (B, H, C, hd)
+        vT = jnp.transpose(v, (0, 2, 1, 3))
+        k_scale = v_scale = None
+        if quantized:
+            ks = self.variable("cache", "key_scale", jnp.zeros,
+                               (B, h, 1, cfg.max_len), jnp.float32)
+            vs = self.variable("cache", "value_scale", jnp.zeros,
+                               (B, h, 1, cfg.max_len), jnp.float32)
+            k8, k_sc = DA.quantize_kv(kT)
+            v8, v_sc = DA.quantize_kv(vT)
+            ck.value = lax.dynamic_update_slice(ck.value, k8,
+                                                (0, 0, index, 0))
+            cv.value = lax.dynamic_update_slice(cv.value, v8,
+                                                (0, 0, index, 0))
+            ks.value = lax.dynamic_update_slice(ks.value,
+                                                k_sc[:, :, None, :],
+                                                (0, 0, 0, index))
+            vs.value = lax.dynamic_update_slice(vs.value,
+                                                v_sc[:, :, None, :],
+                                                (0, 0, 0, index))
+            k_scale, v_scale = ks.value, vs.value
+        else:
+            ck.value = lax.dynamic_update_slice(ck.value, kT,
+                                                (0, 0, index, 0))
+            cv.value = lax.dynamic_update_slice(cv.value, vT,
+                                                (0, 0, index, 0))
+
+        if impl == "pallas":
+            blk_k = DA.decode_blk_k_for(b=B, h=h, s=cfg.max_len, d=hd,
+                                        dtype=cache_dtype)
+            if DA.supported(cfg.max_len, blk_k, C):
+                return DA.decode_attention(
+                    q, ck.value, cv.value, index,
+                    key_scale=k_scale, value_scale=v_scale, blk_k=blk_k)
+            if C <= DA.DECODE_MAX_CHUNK:
+                # a chunk the kernel SHOULD take fell through (no usable
+                # KV block for this max_len) — that is a degradation
+                # worth the fallback registry; an over-cap prefill chunk
+                # routing dense is the designed split, not a fallback
+                from distributed_tensorflow_guide_tpu.ops.flash_attention import (  # noqa: E501
+                    _note_fallback,
+                )
+
+                _note_fallback(
+                    cfg.max_len, hd, C, blk_k, origin="decode_attention",
+                    msg=f"decode_attention: max_len {cfg.max_len} has no "
+                        f"usable KV block (resolved {blk_k}); falling "
+                        "back to the dense full-cache path (slower)")
+
+        # dense attention on the kernel layout, dequant folded into the
+        # contractions (the scale is constant along the contracted hd axis
+        # for QK^T and along the probability axis for AV, so it factors
+        # out exactly — no dequantized cache copy is ever materialized)
+        scores = jnp.einsum("bqhd,bhkd->bhqk", q,
+                            ck.value.astype(cfg.dtype)) / jnp.sqrt(
             hd).astype(cfg.dtype)
+        if quantized:
+            scores = scores.astype(jnp.float32) * k_scale  # (B, H, 1, S)
         q_pos = index + jnp.arange(C)
         k_pos = jnp.arange(cfg.max_len)
         mask = k_pos[None, :] <= q_pos[:, None]  # (C, max_len)
         scores = jnp.where(mask[None, None], scores,
-                           jnp.finfo(cfg.dtype).min)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(
-            cfg.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
+        if quantized:
+            probs = probs * v_scale  # fold v dequant into the AV columns
+        probs = probs.astype(cfg.dtype)
+        return jnp.einsum("bhqk,bhkd->bqhd", probs,
+                          cv.value.astype(cfg.dtype))
 
 
 class MLP(nn.Module):

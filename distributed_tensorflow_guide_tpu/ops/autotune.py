@@ -35,11 +35,38 @@ import threading
 from pathlib import Path
 from typing import Callable, NamedTuple
 
-KERNELS = ("flash_fwd", "flash_dq", "flash_dkv", "carry_step")
+KERNELS = ("flash_fwd", "flash_dq", "flash_dkv", "carry_step",
+           "decode_attend")
 
 # The tested fallback every call site gets on a table miss — the historical
 # hardcode, now the one definition it reduces to.
 DEFAULT_BLOCKS: tuple[int, int] = (128, 128)
+
+# --- decode attention (ops/decode_attention.py) ----------------------------
+# Same table, same platform keying, same CPU defaults-only contract. The
+# decode kernel streams the KV cache past a 1-token query chunk, so its
+# only real tuning axis is the KV block edge (blk_k); the Q edge is pinned
+# at the sublane-padded chunk (DECODE_CHUNK_SUBLANES). Entries key on
+# s = max_len and dtype = the CACHE dtype (int8 entries are distinct from
+# bf16 ones — the bandwidth/VMEM balance differs), causal=False (the
+# length masking is runtime state, not a block-liveness regime).
+DECODE_KERNEL = "decode_attend"
+DECODE_CHUNK_SUBLANES = 8  # single-token q chunks are padded to one sublane
+
+# Largest q chunk the kernel accepts: the q tile is NOT blocked (one grid
+# cell holds the whole padded chunk + its (chunk, blk_k) f32 score
+# temporaries), so an unbounded prefill chunk could exceed VMEM at serve
+# time even though the chunk=1 sweep passed. Chunks past this route to the
+# dense path (prefill is one big MXU matmul — bandwidth is not its
+# bottleneck); decode steps (1) and speculative verify chunks (G+1) sit
+# far below it. The VMEM candidate filter charges THIS worst case, not
+# the 8-row decode tile, so a tuned blk_k is safe for every admitted
+# chunk.
+DECODE_MAX_CHUNK = 128
+
+# Tested fallback KV edge on a table miss, clipped by divisibility in
+# decode_attention.decode_blk_k_for (a 32-slot test cache can't take 256).
+DEFAULT_DECODE_BLK_K = 256
 
 # --- chunked fused cross-entropy (ops/fused_ce.py) -------------------------
 # Same table, same platform keying, same CPU defaults-only contract — but a
@@ -519,18 +546,27 @@ def live_block_count(s: int, blk_q: int, blk_k: int, causal: bool) -> int:
 
 
 # MXU matmuls per live (Q-block, KV-block) pair: fwd/carry do qk^T + p.v;
-# dq adds ds.k; dkv does qk^T + p^T.do + do.v^T + ds^T.q.
+# dq adds ds.k; dkv does qk^T + p^T.do + do.v^T + ds^T.q. The decode kernel
+# is the forward pair again (qk^T + p.v) over a sublane-padded 1-token chunk.
 _MXU_PASSES = {"flash_fwd": 2, "carry_step": 2, "flash_dq": 3,
-               "flash_dkv": 4}
+               "flash_dkv": 4, "decode_attend": 2}
 
 
 def kernel_flops(kernel: str, *, b: int, h: int, s: int, d: int,
                  blocks: tuple[int, int], causal: bool = True) -> float:
     """Hardware MXU FLOPs of ONE kernel call: 2*M*N*K per matmul over the
-    PADDED head dim (what the MXU executes), live causal blocks only."""
+    PADDED head dim (what the MXU executes), live causal blocks only.
+
+    The decode kernel's grid has ONE fixed q tile (the sublane-padded
+    chunk, ``blocks[0]``) against all s/blk_k KV blocks — charging the
+    training kernels' (s/blk_q) x (s/blk_k) grid would inflate its FLOP
+    throughput ~s/blk_q-fold."""
     bq, bk = blocks
     dp = padded_head_dim(d)
-    live = live_block_count(s, bq, bk, causal)
+    if kernel == DECODE_KERNEL:
+        live = s // bk
+    else:
+        live = live_block_count(s, bq, bk, causal)
     return 2.0 * _MXU_PASSES[kernel] * bq * bk * dp * live * b * h
 
 
@@ -586,6 +622,18 @@ def kernel_vmem_bytes(kernel: str, blk_q: int, blk_k: int, dp: int,
         tiles = (2 * q_t + 4 * k_t) * io + 2 * l_t * 4
         scratch = 2 * k_t * 4
         body = 4 * score
+    elif kernel == "decode_attend":
+        # q tile + K/V cache tiles (at the CACHE dtype — int8 is what makes
+        # the big edges affordable) + the two (1, blk_k) f32 scale rows;
+        # scratch = (m, l) lane-broadcast stats + the f32 accumulator;
+        # body = the f32 score/probability temporaries. The q-side terms
+        # are charged at DECODE_MAX_CHUNK, not the 8-row decode tile: the
+        # same tuned blk_k also serves prefill/verify chunks up to that
+        # cap, and a candidate must fit VMEM at the worst admitted chunk.
+        cq = DECODE_MAX_CHUNK * dp
+        tiles = cq * io + 2 * k_t * io + 2 * blk_k * 4
+        scratch = (2 * DECODE_MAX_CHUNK * LANE + cq) * 4
+        body = 2 * DECODE_MAX_CHUNK * blk_k * 4
     else:
         raise ValueError(f"unknown kernel {kernel!r}")
     return 2 * tiles + scratch + body
@@ -594,9 +642,18 @@ def kernel_vmem_bytes(kernel: str, blk_q: int, blk_k: int, dp: int,
 def candidate_blocks(kernel: str, *, s: int, d: int,
                      dtype) -> list[tuple[int, int]]:
     """The sweep grid for one kernel/shape: candidate edges that divide the
-    sequence and fit the VMEM budget."""
+    sequence and fit the VMEM budget. The decode kernel only sweeps the KV
+    edge (its Q edge is the fixed sublane-padded token chunk)."""
     dp = padded_head_dim(d)
     edges = [e for e in CANDIDATE_EDGES if e <= s and s % e == 0]
+    if kernel == DECODE_KERNEL:
+        bq = DECODE_CHUNK_SUBLANES
+        return [
+            (bq, bk) for bk in edges
+            if s % bq == 0
+            and kernel_vmem_bytes(kernel, bq, bk, dp,
+                                  dtype) <= VMEM_BUDGET_BYTES
+        ]
     return [
         (bq, bk)
         for bq in edges for bk in edges
@@ -726,6 +783,16 @@ def ensure_tuned(kernel: str, *, b: int, h: int, s: int, d: int, dtype,
     if not cands:
         return blocks_for(kernel, b=b, h=h, s=s, d=d, dtype=dtype,
                           causal=causal, platform=plat)
+    if measure is None and kernel == DECODE_KERNEL:
+        # the decode kernel's operands (int8 cache + scales vs a plain
+        # cache) live with the kernel — lazy import avoids the cycle
+        from distributed_tensorflow_guide_tpu.ops import decode_attention
+
+        def measure(kern, blocks):  # noqa: F811 - documented injection point
+            fn = decode_attention.make_decode_runner(
+                blocks[1], b=b, h=h, s=s, d=d, dtype=dtype)
+            return measure_runner(fn, iters=iters)
+
     if measure is None:
         ops = kernel_operands(kernel, b=b, h=h, s=s, d=d, dtype=dtype,
                               causal=causal)  # once per sweep, not per cand

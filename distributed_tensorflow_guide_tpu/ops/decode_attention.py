@@ -1,0 +1,361 @@
+"""Pallas decode-attention: stream the KV cache past a 1-token query chunk.
+
+The round-5 capture pinned serving decode at ~4% of the v5e's HBM roofline
+(VERDICT #6 target >= 0.4). Decode attention is the purest bandwidth
+workload in the repo — a C-token chunk (C = 1 in the scan loop) against a
+``(B, H, max_len, hd)`` cache — and the XLA dense path pays for it twice:
+the full fixed-size cache is read EVERY step (static shapes attend against
+all ``max_len`` slots, written or not), and the ``(B, H, C, max_len)``
+score/probability intermediates round-trip HBM. This kernel closes both
+gaps:
+
+* **length-aware grid**: the cache length (``index + C``) rides in as a
+  scalar-prefetch operand, dead KV blocks map their BlockSpec index to the
+  last live block (consecutive identical indices elide the DMA — the
+  standard Pallas revisit trick) and skip their compute via ``pl.when`` —
+  so a step at sequence position L reads ~L slots, not ``max_len``;
+* **online softmax in VMEM**: one pass over live KV blocks carrying
+  (m, l, acc) scratch — no score matrix ever hits HBM (the flash-forward
+  algebra, specialized to a query chunk small enough to stay resident);
+* **native int8 cache**: when the cache is quantized
+  (``TransformerConfig.kv_dtype="int8"``), the kernel moves int8 blocks
+  over the wire and dequantizes in-register — the per-slot-per-head f32
+  scales fold into the score columns (k) and the probability columns (v),
+  never into a materialized dequantized cache.
+
+Layout contract (the caller is ``models/transformer.py _decode_attend``):
+q arrives in the public ``(B, C, H, hd)`` layout; the cache collection is
+stored KERNEL-layout ``(B, H, S, hd)`` (plus ``(B, H, 1, S)`` f32 scale
+rows when quantized) so the kernel consumes it without a per-step
+transpose — a transpose would copy the whole cache every step and hand the
+bandwidth win straight back.
+
+Block sizes resolve through the autotune table (``ops/autotune.py``,
+kernel key ``decode_attend``; swept on chip by ``bench_flash_kernel.py
+--tune``, tested fallback on a miss) — same CPU defaults-only hermeticity
+as the flash kernels. On CPU the kernel runs via ``interpret=True`` when
+explicitly requested; ``impl="auto"`` resolves to the dense path there so
+tier-1 traces never contain a Pallas call.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from distributed_tensorflow_guide_tpu.ops import autotune
+from distributed_tensorflow_guide_tpu.ops.autotune import (
+    DECODE_CHUNK_SUBLANES,
+    DECODE_KERNEL,
+    DECODE_MAX_CHUNK,
+    DEFAULT_DECODE_BLK_K,
+)
+from distributed_tensorflow_guide_tpu.ops.flash_attention import (
+    NEG_INF,
+    _interpret,
+    _vmem_scratch,
+    _vmem_spec,
+)
+
+try:  # pltpu resolves fully on TPU builds; interpret mode works regardless
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+LANE = 128
+
+
+# --------------------------------------------------------------------------
+# int8 KV quantization (the write-path helper _decode_attend shares)
+# --------------------------------------------------------------------------
+
+
+def quantize_kv(x):
+    """Per-vector symmetric int8: ``x`` (..., hd) -> (values int8 (..., hd),
+    scales f32 (...,)). One scale per (batch, head, slot) vector — the
+    granularity that keeps dequant a rank-1 broadcast in both the QK^T
+    column direction and the AV probability direction. An all-zero vector
+    maps to scale 1 (not 0) so dequant is always exact-zero, never 0/0."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    values = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return values.astype(jnp.int8), scale
+
+
+# --------------------------------------------------------------------------
+# block resolution (the ONLY lookup path — key construction lives here)
+# --------------------------------------------------------------------------
+
+
+def decode_blk_k_for(*, b: int, h: int, s: int, d: int, dtype,
+                     platform: str | None = None) -> int:
+    """The KV block edge a decode call site should use: the tuned table
+    entry when one exists (key: s = max_len, dtype = CACHE dtype,
+    causal=False), else the tested default clipped by divisibility. Never
+    sweeps, never writes — safe at trace time on any platform."""
+    hit = autotune.lookup(DECODE_KERNEL, b=b, h=h, s=s, d=d, dtype=dtype,
+                          causal=False, platform=platform)
+    if hit is not None:
+        return hit[1]
+    for cand in (DEFAULT_DECODE_BLK_K, 128, 64, 32, 16, 8):
+        if cand <= s and s % cand == 0:
+            return cand
+    return s
+
+
+def ensure_decode_tuned(*, b: int, h: int, s: int, d: int, dtype,
+                        iters: int = 20,
+                        platform: str | None = None) -> int:
+    """Sweep-and-record the decode KV edge for one (shape, cache-dtype)
+    key — from the table when present (no re-sweep). Refused on CPU, same
+    defaults-only contract as every autotune sweep."""
+    blocks = autotune.ensure_tuned(
+        DECODE_KERNEL, b=b, h=h, s=s, d=d, dtype=dtype, causal=False,
+        iters=iters, platform=platform)
+    return blocks[1]
+
+
+def supported(s: int, blk_k: int, chunk: int = 1) -> bool:
+    """Shapes the kernel handles: sublane-multiple KV edge dividing the
+    cache length, a resolvable grid spec, and a q chunk within the
+    unblocked-tile VMEM cap (``DECODE_MAX_CHUNK`` — the one grid cell
+    holds the whole padded chunk plus its f32 score temporaries). Callers
+    fall back to the dense kernel-layout path otherwise; for a long
+    prefill chunk that is the DESIGNED route, not a degradation."""
+    cp = -(-chunk // DECODE_CHUNK_SUBLANES) * DECODE_CHUNK_SUBLANES
+    return (pltpu is not None and blk_k % 8 == 0 and s % blk_k == 0
+            and s >= blk_k and cp <= DECODE_MAX_CHUNK)
+
+
+# --------------------------------------------------------------------------
+# roofline byte model (bench_flash_kernel's decode rows)
+# --------------------------------------------------------------------------
+
+
+def cache_slot_bytes(head_dim: int, dtype) -> int:
+    """Bytes ONE (slot, head) of the cache occupies: the K and V vectors
+    at the CACHE dtype, plus the two per-slot f32 scales when quantized.
+    The single definition both byte models scale up —
+    ``models/generation.py decode_cache_bytes_per_step`` (whole-cache,
+    per decode step) and :func:`decode_kernel_hbm_bytes` (one kernel
+    call) — so the serving bench and the kernel-only bench can never
+    disagree about the same cache."""
+    import numpy as np
+
+    io = np.dtype(dtype).itemsize
+    scales = 8 if np.dtype(dtype) == np.dtype(np.int8) else 0
+    return 2 * head_dim * io + scales
+
+
+def decode_kernel_hbm_bytes(*, b: int, h: int, s: int, d: int, dtype,
+                            chunk: int = 1, q_dtype=jnp.bfloat16,
+                            effective_len: int | None = None) -> float:
+    """Minimal algorithmic HBM traffic of ONE kernel call: the q chunk and
+    the output written once, the LIVE slice of the cache (K and V, plus the
+    f32 scale rows when the cache is int8) read once. ``effective_len``
+    models the length-aware grid (block-rounded by the caller); the default
+    is the full cache — the dense static-shape ceiling."""
+    import numpy as np
+
+    length = s if effective_len is None else min(int(effective_len), s)
+    q_io = np.dtype(q_dtype).itemsize
+    cache = b * h * length * cache_slot_bytes(d, dtype)
+    qo = 2 * b * h * chunk * d * q_io
+    return float(cache + qo)
+
+
+# --------------------------------------------------------------------------
+# the kernel
+# --------------------------------------------------------------------------
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, scale: float,
+                   blk_k: int, chunk: int, quantized: bool):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs
+    j = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # dead blocks (entirely past the written length) contribute nothing —
+    # their BlockSpec index maps to the last live block so no DMA moved
+    # either; this guard skips the compute.
+    length = len_ref[0]
+
+    @pl.when(j * blk_k < length)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)  # (Cp, hd)
+        k = k_ref[0, 0].astype(jnp.float32)  # (blk_k, hd)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (Cp, blk_k)
+        if quantized:
+            # k dequant folds into the score COLUMNS (scale is constant
+            # along the contracted hd axis, so it factors out exactly)
+            s = s * ks_ref[0, 0]  # (1, blk_k) broadcast
+        cp = q.shape[0]
+        # rows beyond the logical chunk are sublane padding: clamp their
+        # position to the last real row (finite softmax, sliced off later)
+        rows = jnp.minimum(
+            jax.lax.broadcasted_iota(jnp.int32, (cp, blk_k), 0), chunk - 1)
+        q_pos = (length - chunk) + rows
+        k_pos = j * blk_k + jax.lax.broadcasted_iota(
+            jnp.int32, (cp, blk_k), 1)
+        # key_pos <= q_pos enforces causality within the chunk AND hides
+        # every not-yet-written slot (q_pos < length by construction) —
+        # the same single predicate as the dense path
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        l_scr[:] = jnp.broadcast_to(l_prev * alpha
+                                    + jnp.sum(p, axis=1, keepdims=True),
+                                    l_scr.shape)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        if quantized:
+            # v dequant folds into the probability COLUMNS — the
+            # normalizer l above deliberately sums the UNscaled p
+            p = p * vs_ref[0, 0]  # (1, blk_k) broadcast
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_kv - 1)
+    def _():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def decode_attention(q, cached_key, cached_value, index, *,
+                     key_scale=None, value_scale=None,
+                     blk_k: int | None = None):
+    """Length-aware cache attention for one decode/prefill chunk.
+
+    ``q``: (B, C, H, hd) public layout (C = 1 per decode step, C = prompt
+    length at prefill). ``cached_key``/``cached_value``: (B, H, S, hd)
+    kernel layout — int8 with ``key_scale``/``value_scale`` (B, H, 1, S)
+    f32 when the cache is quantized, else the model dtype with no scales.
+    ``index``: the (traced) write position of the chunk's first token; the
+    chunk's k/v must already be written at [index, index + C) — this
+    function only READS the cache. Returns (B, C, H, hd) in q's dtype.
+
+    ``blk_k`` pins the KV block edge (what the parity tests and the sweep
+    use); by default it resolves through the autotune table
+    (:func:`decode_blk_k_for`).
+    """
+    B, C, H, hd = q.shape
+    S = cached_key.shape[2]
+    quantized = key_scale is not None
+    if quantized != (value_scale is not None):
+        raise ValueError("key_scale and value_scale must be given together")
+    if blk_k is None:
+        blk_k = decode_blk_k_for(b=B, h=H, s=S, d=hd,
+                                 dtype=cached_key.dtype)
+    if not supported(S, blk_k, C):
+        raise ValueError(
+            f"decode_attention: blk_k {blk_k} / chunk {C} unsupported for "
+            f"cache length {S} (need a sublane multiple dividing S and a "
+            f"chunk <= {DECODE_MAX_CHUNK}) — callers gate on supported() "
+            "and fall back to the dense path")
+    cp = -(-C // DECODE_CHUNK_SUBLANES) * DECODE_CHUNK_SUBLANES
+    qk = jnp.transpose(q, (0, 2, 1, 3))  # (B, H, C, hd)
+    if cp != C:
+        qk = jnp.pad(qk, ((0, 0), (0, 0), (0, cp - C), (0, 0)))
+    length = jnp.reshape(jnp.asarray(index + C, jnp.int32), (1,))
+    scale = 1.0 / (hd ** 0.5)
+    n_kv = S // blk_k
+
+    def live_j(j, len_ref):
+        # dead blocks revisit the last live block: consecutive identical
+        # BlockSpec indices make the Pallas pipeline skip the DMA, which is
+        # what turns the static grid into a length-aware read
+        last_live = (len_ref[0] + blk_k - 1) // blk_k - 1
+        return jnp.minimum(j, last_live)
+
+    q_spec = _vmem_spec((1, 1, cp, hd), lambda b, h, j, L: (b, h, 0, 0))
+    kv_spec = _vmem_spec((1, 1, blk_k, hd),
+                         lambda b, h, j, L: (b, h, live_j(j, L), 0))
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qk, cached_key, cached_value]
+    if quantized:
+        sc_spec = _vmem_spec((1, 1, 1, blk_k),
+                             lambda b, h, j, L: (b, h, 0, live_j(j, L)))
+        in_specs += [sc_spec, sc_spec]
+        operands += [key_scale, value_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, H, n_kv),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        scratch_shapes=[
+            _vmem_scratch((cp, LANE), jnp.float32),
+            _vmem_scratch((cp, LANE), jnp.float32),
+            _vmem_scratch((cp, hd), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, scale=scale, blk_k=blk_k,
+                               chunk=C, quantized=quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, cp, hd), q.dtype),
+        interpret=_interpret(),
+    )(length, *operands)
+    return jnp.transpose(out[:, :, :C], (0, 2, 1, 3))
+
+
+# --------------------------------------------------------------------------
+# sweep/microbench runner (bench_flash_kernel decode rows, autotune sweep)
+# --------------------------------------------------------------------------
+
+
+def make_decode_runner(blk_k: int, *, b: int, h: int, s: int, d: int,
+                       dtype, chunk: int = 1,
+                       seed: int = 0):
+    """A zero-arg callable running ONE decode-attention call at ``blk_k``
+    on a FULL cache (length = s, the steady-state worst case the tuner
+    should optimize) — the unit the sweep and the kernel-only microbench
+    time. ``dtype`` is the CACHE dtype; int8 builds the quantized operands
+    (values + per-slot scales), anything else a plain cache."""
+    quantized = jnp.dtype(dtype) == jnp.dtype(jnp.int8)
+    q_dtype = jnp.bfloat16 if quantized else dtype
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(keys[0], (b, chunk, h, d),
+                          jnp.float32).astype(q_dtype)
+    kf = jax.random.normal(keys[1], (b, h, s, d), jnp.float32)
+    vf = jax.random.normal(keys[2], (b, h, s, d), jnp.float32)
+    if quantized:
+        k8, ks = quantize_kv(kf)
+        v8, vs = quantize_kv(vf)
+        ops = (q, k8, v8, ks[:, :, None, :], vs[:, :, None, :])
+
+        def call(q, k8, v8, ks, vs):
+            return decode_attention(q, k8, v8, s - chunk, key_scale=ks,
+                                    value_scale=vs, blk_k=blk_k)
+    else:
+        ops = (q, kf.astype(dtype), vf.astype(dtype))
+
+        def call(q, k, v):
+            return decode_attention(q, k, v, s - chunk, blk_k=blk_k)
+
+    f = jax.jit(call)
+    return lambda: f(*ops)

@@ -53,6 +53,19 @@ def main() -> None:
                              "bf16_remat_attn"],
                     help="mixed-precision policy (core/precision.py); "
                          "'auto' keeps this demo's f32")
+    ap.add_argument("--kv-dtype", choices=["model", "int8"],
+                    default="model",
+                    help="serving KV-cache dtype; 'int8' quantizes the "
+                         "cache (docs/serving.md decode levers)")
+    ap.add_argument("--decode-impl", choices=["auto", "dense", "pallas"],
+                    default="auto",
+                    help="decode-attention impl ('auto' = the Pallas "
+                         "length-aware kernel on TPU, dense elsewhere)")
+    ap.add_argument("--spec-draft-layers", type=int, default=0,
+                    help="self-speculative decoding with this many draft "
+                         "prefix layers (0 = off; output is identical "
+                         "either way — the knob only changes the "
+                         "schedule)")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -146,15 +159,27 @@ def main() -> None:
             print(f"step {i}: loss={float(m['loss']):.4f} "
                   f"ppl={float(m['perplexity']):.1f}")
 
-    # generate: one compiled program; params already replicated on-mesh
-    gen = make_generate_fn(cfg, max_new_tokens=args.max_new,
-                           temperature=args.temperature, top_k=args.top_k)
+    # generate: one compiled program; params already replicated on-mesh.
+    # The serving config may differ from the training config by the
+    # decode levers only (cache dtype / attend impl are serving-side
+    # state, invisible to the trained params).
+    import dataclasses
+
+    gen_cfg = dataclasses.replace(
+        cfg, kv_dtype="int8" if args.kv_dtype == "int8" else None,
+        decode_impl=args.decode_impl)
+    gen = make_generate_fn(gen_cfg, max_new_tokens=args.max_new,
+                           temperature=args.temperature, top_k=args.top_k,
+                           spec_draft_layers=args.spec_draft_layers)
     prompt_ids = np.asarray([tokenizer.encode(args.prompt.encode())],
                             np.int32)
     out = np.asarray(gen(state.params, prompt_ids, jax.random.PRNGKey(0)))
     text = tokenizer.decode(out[0].tolist())
     print(f"prompt : {args.prompt!r}")
     print(f"output : {text!r}")
+    if gen.last_stats is not None:
+        stats = {k: int(v) for k, v in gen.last_stats.items()}
+        print(f"speculative: {stats}")
     print("generate ok")
 
 
