@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""DCN-hybrid two-tier bench: the sync_period/exposed-DCN tradeoff plus
+recovery MTTR across an elastic resize.
+
+Two phases, both real on CPU (like bench_resilience, the hardware under
+test is the strategy program + the supervision machinery, not the
+matmuls):
+
+1. **tradeoff** (in-process, fake-device two-tier mesh): times one outer
+   round of ``parallel/multislice.MultiSliceLocalSGD`` at the requested
+   ``--sync-period`` with the outer DCN sync ON and OFF (argv-identical
+   programs except the outer collectives) and at ``sync_period=1`` (the
+   sync-DP-cadence anchor every row is normalized against). Emits the
+   closed-form ``outer_sync_bytes`` ring model, the MEASURED exposed
+   outer-sync fraction of the round, and the MODELED ``exposed_dcn_frac``
+   at the DCN peak table's rate (``--dcn-gbps`` assumption off-TPU —
+   labeled ``_model``, never confusable with a capture).
+
+2. **elastic** (``--elastic on``): a seeded slice-loss/slice-return storm
+   (``FaultSchedule.random_world``) through ``train/elastic_world.py``
+   over real OS processes — reports ``recovery_mttr_s`` (wall clock from
+   the crashed generation's last consumed round to the reduced world's
+   first: relaunch + handshake + restore ladder + recompile) and the
+   exactly-once stream-accounting verdict across the resize.
+
+One JSON line; ``--sync-period`` is the battery's one-variable knob
+(``dcn_hybrid_sync{1,8,64}`` rows), ``--elastic`` stays pinned off on the
+sweep rows so the only difference is the knob.
+"""
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.common import (
+    dcn_extras,
+    device_dcn_peak,
+    device_setup,
+    outer_sync_bytes,
+    report,
+    time_steps,
+)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slices", type=int, default=2,
+                    help="DCN tier size (fake slices off-TPU)")
+    ap.add_argument("--sync-period", type=int, default=8,
+                    help="inner steps per outer DCN sync (the knob)")
+    ap.add_argument("--rounds", type=int, default=8,
+                    help="timed outer rounds per phase")
+    ap.add_argument("--state-mb", type=int, default=8,
+                    help="float param size (MiB) — what the outer sync moves")
+    ap.add_argument("--global-batch", type=int, default=8)
+    ap.add_argument("--outer-lr", type=float, default=0.7)
+    ap.add_argument("--outer-momentum", type=float, default=0.9)
+    ap.add_argument("--dcn-gbps", type=float, default=12.5,
+                    help="assumed DCN GB/s for the modeled fraction when "
+                         "no TPU DCN peak is attached")
+    ap.add_argument("--elastic", choices=["on", "off"], default="off",
+                    help="run the slice-loss/regrow resize phase")
+    ap.add_argument("--elastic-steps", type=int, default=16,
+                    help="outer rounds of the elastic phase")
+    ap.add_argument("--procs-per-slice", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workdir", default="",
+                    help="elastic-phase scratch (default: a tmp dir)")
+    ap.add_argument("--fake-devices", type=int, default=8)
+    ap.add_argument("--small", action="store_true",
+                    help="tiny liveness geometry (smoke suite)")
+    args = ap.parse_args()
+    if args.small:
+        args.rounds = min(args.rounds, 3)
+        args.state_mb = min(args.state_mb, 1)
+        args.elastic_steps = min(args.elastic_steps, 10)
+        args.sync_period = min(args.sync_period, 2)
+
+    device_setup(args.fake_devices)
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax.training import train_state
+
+    from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec
+    from distributed_tensorflow_guide_tpu.parallel.multislice import (
+        MultiSliceLocalSGD,
+        two_tier_mesh,
+    )
+
+    # params carry the bytes (a (d, m) matrix totalling --state-mb — what
+    # the outer sync moves); the batch stays NARROW (rows x d) so the
+    # superbatch is a few MB even at --sync-period 64, not k * state_mb
+    # (a (k, rows, n) batch would be ~4.3 GiB at the sync64 battery row)
+    n = args.state_mb * (1 << 20) // 4
+    d = min(1024, n)
+    m = n // d
+    batch = args.global_batch
+    mesh = two_tier_mesh(MeshSpec(), n_slices=args.slices)
+
+    def loss_fn(params, sub):
+        err = sub["x"] @ params["w"] - sub["y"]
+        return jnp.mean(err ** 2), {}
+
+    def make_state(strat):
+        return strat.replicate(strat.init(train_state.TrainState.create(
+            apply_fn=None,
+            params={"w": jnp.zeros((d, m), jnp.float32)},
+            tx=optax.sgd(0.05),
+        )))
+
+    def superbatch(strat, k):
+        rng = np.random.RandomState(args.seed)
+        return strat.shard_batch({
+            "x": rng.randn(k, batch, d).astype(np.float32),
+            "y": rng.randn(k, batch, m).astype(np.float32),
+        })
+
+    def timed_round(sync_period, outer):
+        strat = MultiSliceLocalSGD(
+            mesh, sync_period, outer_lr=args.outer_lr,
+            outer_momentum=args.outer_momentum, outer=outer)
+        state = make_state(strat)
+        step = strat.make_train_step(loss_fn, donate=False)
+        dt, state = time_steps(
+            step, state, superbatch(strat, sync_period),
+            warmup=2, steps=args.rounds, fence_key="loss")
+        return dt / args.rounds, strat, state
+
+    k = args.sync_period
+    t_on, strat, state = timed_round(k, "on")
+    t_off, _, _ = timed_round(k, "off")
+    t_sync1, _, _ = timed_round(1, "on")
+
+    float_bytes = strat.outer_float_bytes(state)
+    sync_bytes = outer_sync_bytes(float_bytes, args.slices)
+    exposed_measured = max(0.0, t_on - t_off) / t_on if t_on > 0 else 0.0
+    peak = device_dcn_peak() or args.dcn_gbps * 1e9
+    t_dcn_model = sync_bytes / peak
+    exposed_model = t_dcn_model / (t_dcn_model + t_off) if t_off > 0 else 0.0
+
+    extras = dict(
+        sync_period=k,
+        steps_between_sync=k,
+        slices=args.slices,
+        state_mb=args.state_mb,
+        outer_float_bytes=float_bytes,
+        outer_sync_bytes=round(sync_bytes, 1),
+        round_s_outer_on=round(t_on, 5),
+        round_s_outer_off=round(t_off, 5),
+        round_s_sync1=round(t_sync1, 5),
+        steps_per_sec_sync1=round(1.0 / t_sync1, 3),
+        exposed_dcn_frac_measured=round(exposed_measured, 4),
+        exposed_dcn_frac_model=round(exposed_model, 4),
+        elastic=args.elastic,
+        seed=args.seed,
+        **dcn_extras(sync_bytes,
+                     max(0.0, t_on - t_off) or None,
+                     assumed_gbytes_per_s=(
+                         None if device_dcn_peak() else args.dcn_gbps)),
+    )
+
+    # ---- elastic resize phase ---------------------------------------------
+    if args.elastic == "on":
+        from distributed_tensorflow_guide_tpu.testing.chaos import (
+            FaultSchedule,
+        )
+        from distributed_tensorflow_guide_tpu.train.elastic_world import (
+            ElasticSupervisor,
+            toy_spec,
+        )
+
+        scratch = Path(args.workdir or
+                       tempfile.mkdtemp(prefix="dtg_dcn_hybrid_"))
+        sched = FaultSchedule.random_world(
+            args.seed, n_slices=args.slices,
+            max_position=args.elastic_steps - 2, min_position=2,
+            min_gap=3)
+        planned = [f"{f.kind}@{f.position}(slice {f.slice_id})"
+                   for f in sched.world_events()]
+        sup = ElasticSupervisor(
+            sched, n_slices=args.slices,
+            procs_per_slice=args.procs_per_slice,
+            base_spec=toy_spec(
+                total_steps=args.elastic_steps, ckpt_every=4,
+                sync_period=min(k, 4), global_batch=8, dim=4,
+                seed=args.seed, outer_lr=args.outer_lr,
+                outer_momentum=args.outer_momentum),
+            ckpt_dir=scratch / "ckpt", workdir=scratch / "work",
+            timeout=150.0, failure_grace=5.0,
+        )
+        t0 = time.perf_counter()
+        rep = sup.run()
+        ok, problems = rep.accounting(args.elastic_steps, 8)
+        extras.update(
+            recovery_mttr_s=(round(float(np.mean(rep.mttr_s)), 4)
+                             if rep.mttr_s else None),
+            elastic_wall_s=round(time.perf_counter() - t0, 2),
+            elastic_generations=len(rep.timeline),
+            elastic_events=planned,
+            accounting_ok=ok,
+            accounting_problems=problems[:4],
+        )
+
+    # headline: inner steps/sec at the requested cadence, normalized
+    # against the sync-every-step anchor — the DOWNPOUR bandwidth economy
+    # of the DCN tier, measured
+    report(
+        "dcn_hybrid",
+        k / t_on,
+        "steps/sec",
+        baseline=1.0 / t_sync1,
+        **extras,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -295,6 +295,31 @@ def device_ici_peak() -> float | None:
     return _device_peak(_TPU_ICI_PEAK)
 
 
+# Per-chip DCN bandwidth (bytes/s, one direction) — the SLOW tier of a
+# multi-slice deployment: each chip's share of its host's data-center NICs
+# (100/200-Gbps class per the public multislice materials), NOT a chip-local
+# link. Same device_kind substring keying as the FLOP/HBM/ICI tables. Note
+# the ~16-50x gap vs _TPU_ICI_PEAK — that ratio is WHY the two-tier
+# strategy (parallel/multislice.py) crosses DCN once per sync_period
+# instead of once per step. Like every table here this is the ROOFLINE
+# denominator of record pending an on-deployment capture; a measured
+# dcn_roofline_frac near 1.0 means the outer sync is wire-bound.
+_TPU_DCN_PEAK: dict[str, float] = {
+    "v5 lite": 12.5e9, "v5litepod": 12.5e9, "v5e": 12.5e9,
+    "v5p": 25e9,
+    "v6 lite": 25e9, "v6e": 25e9,
+    "v4": 25e9,
+    "v3": 12.5e9,
+    "v2": 12.5e9,
+}
+
+
+def device_dcn_peak() -> float | None:
+    """Per-chip DCN bandwidth (bytes/s) of the attached accelerator, or
+    None off-TPU — same contract as :func:`device_peak_flops`."""
+    return _device_peak(_TPU_DCN_PEAK)
+
+
 # --- closed-form per-device collective traffic (the comm_bytes_model) -----
 #
 # Ring-algorithm accounting, per device, per step: what bench_comm_overlap
@@ -340,6 +365,46 @@ def pipeline_ppermute_bytes(act_bytes: float, num_microbatches: int,
     if stages <= 1:
         return 0.0
     return 2.0 * num_microbatches * act_bytes * (stages - 1) / stages
+
+
+def outer_sync_bytes(float_state_bytes: float, n_slices: int) -> float:
+    """Two-tier outer sync (parallel/multislice.py): the per-round DCN
+    traffic per participating device. The outer collective is a ring
+    all-reduce ACROSS SLICES of the float param delta + float inner
+    optimizer state — same 2·P·(n−1)/n ring accounting as
+    :func:`dp_allreduce_bytes`, with n = the slice count and P = the float
+    state bytes (``MultiSliceLocalSGD.outer_float_bytes``). Zero at one
+    slice (the pmean compiles to a no-op). Divide by ``sync_period``
+    inner steps for the amortized per-step DCN load."""
+    if n_slices <= 1:
+        return 0.0
+    return 2.0 * float_state_bytes * (n_slices - 1) / n_slices
+
+
+def dcn_extras(comm_bytes: float, comm_secs: float | None = None,
+               assumed_gbytes_per_s: float | None = None) -> dict:
+    """Extra report() keys for DCN-tier-honest benches, mirroring
+    :func:`ici_extras`: the closed-form per-device outer-sync bytes, and —
+    when the caller measured the outer-sync time — the achieved wire rate
+    plus the fraction of the attached part's DCN peak (real hardware
+    only). ``assumed_gbytes_per_s`` substitutes an assumed peak off-TPU so
+    CPU runs can still emit a MODELED fraction; the key is then suffixed
+    ``_model`` and the assumption echoed, so it can never be read as a
+    capture."""
+    out: dict = {"dcn_comm_bytes": round(float(comm_bytes), 1),
+                 "dcn_comm_gb": round(comm_bytes / 1e9, 4)}
+    peak = device_dcn_peak()
+    if comm_secs is not None and comm_secs > 0 and comm_bytes > 0:
+        achieved = comm_bytes / comm_secs
+        out["dcn_gb_per_s"] = round(achieved / 1e9, 3)
+        if peak:
+            out["dcn_roofline_frac"] = round(achieved / peak, 4)
+        elif assumed_gbytes_per_s:
+            out["dcn_roofline_frac_model"] = round(
+                achieved / (assumed_gbytes_per_s * 1e9), 4)
+    if peak is None and assumed_gbytes_per_s:
+        out["dcn_peak_gb_per_s_assumed"] = assumed_gbytes_per_s
+    return out
 
 
 def ici_extras(comm_bytes: float, comm_secs: float | None) -> dict:

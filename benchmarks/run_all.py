@@ -29,6 +29,7 @@ BENCHES = [
     "bench_fused_ce.py",      # LM-head loss alone: naive vs chunked fused CE
     "bench_comm_overlap.py",  # ICI overlap: exposed-comm fraction A/B
     "bench_resilience.py",    # checkpoint overhead + MTTR/goodput (CPU-real)
+    "bench_dcn_hybrid.py",    # two-tier DCN sync tradeoff + elastic resize
 ]
 
 # Tiny fake-device configs, small enough for CPU (also used by
@@ -112,6 +113,15 @@ SMOKE = {
         # host CPU are the hardware under test), so even the smoke's small
         # geometry produces real save_overhead/MTTR/goodput numbers
         ["--small", "--seed", "0"],
+    "bench_dcn_hybrid.py":
+        # same contract as bench_resilience: the two-tier round timings
+        # and the outer-sync byte model are real on CPU. Elastic stays
+        # OFF here (the kill/regrow multiprocess phase is covered by
+        # tests/test_multislice.py and the battery's dcn_hybrid
+        # continuity row — re-booting JAX processes per smoke run would
+        # eat the tier-1 wall-clock budget for coverage tier-1 already
+        # has)
+        ["--fake-devices", "8", "--small", "--seed", "0"],
 }
 
 

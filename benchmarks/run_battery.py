@@ -169,6 +169,29 @@ BATTERY: list[tuple[str, list[str], int]] = [
      ["benchmarks/bench_resilience.py", "--async-save", "on"], 1200),
     ("resilience_overhead_sync",
      ["benchmarks/bench_resilience.py", "--async-save", "off"], 1200),
+    # DCN-hybrid two-tier rows (round 12). Continuity row pins EVERY new
+    # knob explicitly (slices/sync-period/outer-momentum/elastic — none
+    # may drift by default) and carries the elastic resize MTTR capture;
+    # the sync rows are argv-identical to each other except --sync-period
+    # (the round-7 one-variable convention), elastic pinned off so the
+    # knob is the only difference. Platform-independent: real numbers on
+    # CPU over the multiprocess runner, like the resilience rows.
+    ("dcn_hybrid",
+     ["benchmarks/bench_dcn_hybrid.py", "--slices", "2", "--sync-period",
+      "8", "--outer-momentum", "0.9", "--elastic", "on", "--seed", "0"],
+     1800),
+    ("dcn_hybrid_sync1",
+     ["benchmarks/bench_dcn_hybrid.py", "--slices", "2", "--sync-period",
+      "1", "--outer-momentum", "0.9", "--elastic", "off", "--seed", "0"],
+     1200),
+    ("dcn_hybrid_sync8",
+     ["benchmarks/bench_dcn_hybrid.py", "--slices", "2", "--sync-period",
+      "8", "--outer-momentum", "0.9", "--elastic", "off", "--seed", "0"],
+     1200),
+    ("dcn_hybrid_sync64",
+     ["benchmarks/bench_dcn_hybrid.py", "--slices", "2", "--sync-period",
+      "64", "--outer-momentum", "0.9", "--elastic", "off", "--seed", "0"],
+     1200),
     ("native_input", ["benchmarks/bench_native_input.py"], 1200),
     ("resnet_native_input",
      ["benchmarks/bench_resnet_native_input.py"], 1800),

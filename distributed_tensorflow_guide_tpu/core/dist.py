@@ -253,6 +253,97 @@ def initialize(config: DistConfig | None = None) -> None:
     )
 
 
+def reinitialize(config: DistConfig | None = None) -> None:
+    """Tear down and re-run the coordinator handshake — the IN-PROCESS
+    elastic-resize path: after a slice loss the surviving hosts re-form
+    the cluster at the new (smaller) world size, and on slice return at
+    the full one. (The relaunch-based resize — fresh processes per
+    generation — lives in train/elastic_world.py and does not need this;
+    this is for deployments that resize without relaunching.)
+
+    Shutdown + initialize is *more* racy than first boot — the new
+    coordinator only comes up after the old incarnation's port is
+    released, and peers re-dial at slightly different times — so the
+    whole cycle goes through :func:`retry_with_backoff`, governed by
+    ``DTG_REINIT_RETRIES`` / ``DTG_REINIT_BACKOFF_S`` (mirroring the
+    ``DTG_INIT_RETRIES`` / ``DTG_INIT_BACKOFF_S`` pair of first init).
+    Unlike :func:`initialize` this is NOT idempotent: every call cycles
+    the handshake, because a resize by definition changes the answer.
+
+    With no coordinator configured anywhere (single-process), the cycle
+    degrades to a best-effort shutdown — there is no cluster to re-form.
+    """
+    global _initialized
+    explicit = config is not None
+    config = config if explicit else DistConfig.from_env()
+    coord, nproc, pid = (
+        config.coordinator_address,
+        config.num_processes,
+        config.process_id,
+    )
+
+    def _shutdown() -> None:
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:  # not initialized / already torn down
+            log.debug("jax.distributed.shutdown before reinit: %s", e)
+
+    # Single-process detection MUST mirror initialize(): an env-driven TPU
+    # pod (auto-detected coordinator, multi-entry TPU_WORKER_HOSTNAMES)
+    # re-forms the cluster too — treating it as single-process would tear
+    # the cluster down and never rebuild it. An explicit all-None config
+    # keeps initialize()'s no-env-promotion guarantee.
+    multi_host_tpu = (not explicit) and "," in os.environ.get(
+        "TPU_WORKER_HOSTNAMES", ""
+    )
+    if (coord is None and nproc is None and not multi_host_tpu) or (
+        coord is None and nproc == 1
+    ):
+        _shutdown()
+        _initialized = False
+        log.debug("single-process reinitialize: shutdown only")
+        return
+    # The flag drops BEFORE the cycle: if every retry fails, a caller that
+    # catches and falls back to initialize() must not hit its idempotent
+    # guard while the runtime is actually torn down.
+    _initialized = False
+    # Same pre-handshake setup as initialize()'s multi-host path: the
+    # platform env must apply for env-driven launches, and CPU
+    # multi-process needs the Gloo collectives opt-in on the 0.4.x line —
+    # a relaunched survivor whose FIRST distributed call is reinitialize()
+    # would otherwise re-form the cluster and die on its first psum.
+    if not explicit:
+        ensure_platform_from_env(strict=True)
+    from distributed_tensorflow_guide_tpu.core import compat
+
+    if (os.environ.get("JAX_PLATFORMS", "") or "").startswith("cpu") or (
+            jax.config.jax_platforms or "").startswith("cpu"):
+        compat.enable_cpu_cross_process_collectives()
+    kwargs = {}
+    if coord is not None:
+        kwargs["coordinator_address"] = coord
+    if nproc is not None:
+        kwargs["num_processes"] = nproc
+    if pid is not None:
+        kwargs["process_id"] = pid
+
+    def _cycle() -> None:
+        _shutdown()
+        jax.distributed.initialize(**kwargs)
+
+    retry_with_backoff(
+        _cycle,
+        attempts=int(os.environ.get("DTG_REINIT_RETRIES", "3")),
+        base_delay_s=float(os.environ.get("DTG_REINIT_BACKOFF_S", "1.0")),
+        what="coordinator re-initialize (elastic resize)",
+    )
+    _initialized = True
+    log.info(
+        "elastic reinitialize: process %d/%d, %d global devices",
+        jax.process_index(), jax.process_count(), jax.device_count(),
+    )
+
+
 def is_chief() -> bool:
     """Process 0 — the one that writes checkpoints/logs.
 

@@ -95,6 +95,19 @@ def _slice_groups(devices: Sequence) -> list[list]:
     return [groups[k] for k in sorted(groups)]
 
 
+def valid_slice_counts(sizes: dict[str, int], dcn_axis: str = "data") -> list[int]:
+    """Slice counts a ``dcn_axis`` of this size can span: its divisors.
+
+    The programmatic answer to :func:`hybrid_device_array`'s divisibility
+    error — callers picking a deployment shape (or an elastic supervisor
+    deciding which reduced worlds are reachable) can query instead of
+    parsing an exception message."""
+    if dcn_axis not in AXES:
+        raise ValueError(f"dcn_axis must be one of {AXES}, got {dcn_axis!r}")
+    n = sizes[dcn_axis]
+    return [k for k in range(1, n + 1) if n % k == 0]
+
+
 def hybrid_device_array(
     sizes: dict[str, int],
     devices: Sequence,
@@ -118,7 +131,9 @@ def hybrid_device_array(
         raise ValueError(
             f"{n_slices} slices need axis {dcn_axis!r} divisible by the "
             f"slice count, got {sizes[dcn_axis]} — either resize "
-            f"{dcn_axis!r} or pick another dcn_axis"
+            f"{dcn_axis!r} or pick another dcn_axis (axis {dcn_axis!r} "
+            f"supports slice counts {valid_slice_counts(sizes, dcn_axis)}; "
+            "see valid_slice_counts())"
         )
     per_slice = dict(sizes)
     per_slice[dcn_axis] //= n_slices

@@ -1,0 +1,347 @@
+"""Two-tier multi-slice training — dense DP inside a slice over ICI,
+infrequent outer parameter sync across slices over DCN.
+
+The reference guide's answer to "more capacity than one machine" was the
+PS/worker cluster; this framework's answer so far was one ICI slice. DCN —
+the data-center network between slices — is orders of magnitude slower than
+ICI (benchmarks/common.py `_TPU_DCN_PEAK` vs `_TPU_ICI_PEAK`), so a naive
+mesh that runs the per-step gradient all-reduce across slices is
+wire-bound. The DiLoCo-style composition (Douillard et al. 2023; the same
+bandwidth economics as DOWNPOUR, see :class:`~.async_ps.LocalSGD`) keeps
+the dense per-step collective entirely on ICI and crosses DCN once every
+``sync_period`` steps with a parameter *delta*:
+
+  * **inner tier** — each slice runs ``sync_period`` synchronous DP steps:
+    per-step gradient ``pmean`` over the within-slice ``data`` axis only
+    (pure ICI), local optimizer update.
+  * **outer tier** — slices average the round's parameter delta
+    ``anchor - params`` over the ``dcn`` axis (the only collective that
+    touches DCN) and apply it through a Nesterov-style outer optimizer;
+    float inner-optimizer state is pmean'd across slices alongside so
+    every slice re-enters the next round bit-identical.
+
+With ``sync_period=1``, ``outer_lr=1`` and ``outer_momentum=0`` the outer
+update collapses to ``params = mean_slices(params_s)`` — plain sync DP
+split into a two-level reduction (pinned against :class:`DataParallel` in
+tests/test_multislice.py, the same parity LocalSGD pins at period 1).
+
+The mesh is explicit about the two tiers: :func:`two_tier_mesh` builds a
+``(dcn, data, model, pipe, context, expert)`` mesh whose leading ``dcn``
+axis is the slice index — the slice-spanning factor that
+``core.mesh.build_mesh`` folds into one logical axis is a *named axis*
+here, so shard_map can address "across slices" and "within a slice" as
+different collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.core.compat import shard_map
+from distributed_tensorflow_guide_tpu.core.mesh import (
+    AXES,
+    MeshSpec,
+    _slice_groups,
+    axis_sizes,
+    num_slices,
+)
+
+DCN_AXIS = "dcn"
+
+LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def _pmean_floats(tree: Any, axis: str) -> Any:
+    """pmean float leaves; pass through ints (identical across replicas —
+    e.g. optax step counts), which integer pmean would corrupt."""
+    return jax.tree.map(
+        lambda x: cc.pmean(x, axis) if _is_float(x) else x, tree
+    )
+
+
+def two_tier_mesh(
+    spec: MeshSpec | None = None,
+    devices=None,
+    *,
+    n_slices: int | None = None,
+) -> Mesh:
+    """Build a ``(dcn, data, model, pipe, context, expert)`` mesh: the
+    leading ``dcn`` axis indexes slices, ``spec`` describes the PER-SLICE
+    (ICI) mesh and is resolved against ``len(devices) / n_slices``.
+
+    Real multi-slice deployments group by ``device.slice_index`` so only
+    the ``dcn`` axis crosses DCN. Backends with no slice info (CPU fake
+    devices — the test/bench harness) are split into ``n_slices``
+    contiguous groups ordered by ``(process_index, id)``: each fake
+    "slice" is a contiguous block of processes, which is exactly the
+    process→slice mapping the elastic harness (train/elastic_world.py)
+    assigns, and keeps batch sharding under ``P((dcn, data))``
+    process-contiguous for ``make_array_from_process_local_data``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_slices is None:
+        n_slices = max(num_slices(devices), 1)
+    if n_slices < 1:
+        raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+    if len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_slices} slices"
+        )
+    per = len(devices) // n_slices
+    spec = spec or MeshSpec()
+    sizes = spec.resolve(per)
+    inner_shape = tuple(sizes[a] for a in AXES)
+    groups = _slice_groups(devices)
+    if len(groups) != n_slices:
+        if len(groups) > 1:
+            # devices DO expose slice topology and it disagrees: chunking
+            # would silently straddle real DCN boundaries, putting the
+            # per-step inner pmean on the slow wire — the exact mistake
+            # this module exists to prevent. Refuse.
+            raise ValueError(
+                f"devices span {len(groups)} real slice(s) but "
+                f"n_slices={n_slices} was requested; pass n_slices="
+                f"{len(groups)} (or omit it) so slice boundaries stay on "
+                "DCN")
+        # no slice info (CPU fake devices): contiguous fake slices
+        devices = sorted(
+            devices,
+            key=lambda d: (getattr(d, "process_index", 0), d.id),
+        )
+        groups = [devices[i * per:(i + 1) * per] for i in range(n_slices)]
+    arrs = []
+    for g in groups:
+        if len(g) != per:
+            raise ValueError(
+                f"uneven slice sizes {[len(x) for x in groups]}; every "
+                f"slice must contribute {per} devices"
+            )
+        try:
+            from jax.experimental import mesh_utils
+
+            arrs.append(
+                mesh_utils.create_device_mesh(inner_shape, devices=list(g))
+            )
+        except Exception:
+            arrs.append(np.asarray(g, dtype=object).reshape(inner_shape))
+    return Mesh(np.stack(arrs), (DCN_AXIS, *AXES))
+
+
+@dataclasses.dataclass
+class TwoTierState:
+    """Carried state of one outer round: the per-slice inner TrainState
+    plus the outer optimizer's momentum (a float-params-shaped tree).
+    Registered as a pytree so it checkpoints/shard_maps like any state."""
+
+    inner: Any
+    outer_momentum: Any
+
+
+jax.tree_util.register_pytree_node(
+    TwoTierState,
+    lambda s: ((s.inner, s.outer_momentum), None),
+    lambda _, kids: TwoTierState(*kids),
+)
+
+
+class MultiSliceLocalSGD:
+    """DiLoCo-style two-tier strategy over a :func:`two_tier_mesh`.
+
+    One call of the compiled train step = one outer round:
+    ``sync_period`` inner sync-DP steps (``lax.scan``; gradient pmean over
+    ``inner_axis`` — within-slice ICI) followed by the one DCN collective:
+    the round's parameter delta pmean'd over ``outer_axis`` and applied
+    through the Nesterov outer optimizer
+
+        m   <- outer_momentum * m + delta_mean
+        upd <- delta_mean + outer_momentum * m        (nesterov)
+               m                                      (heavy-ball)
+        params <- anchor - outer_lr * upd
+
+    plus a pmean of the float inner-optimizer state. ``outer="off"``
+    emits NO DCN collective at all — outer sync, opt-state sync, and the
+    metric scalar (slices train fully independently — numerically wrong
+    on purpose; the timing control benchmarks use to measure the exposed
+    DCN cost must not pay even one latency-bound round-trip per round).
+
+    The super-batch contract matches LocalSGD: leaves shaped
+    ``(sync_period, global_batch, ...)``, global batch sharded over
+    ``(dcn, data)`` jointly — slices take contiguous row blocks, the
+    within-slice data axis subdivides them.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        sync_period: int,
+        *,
+        outer_lr: float = 1.0,
+        outer_momentum: float = 0.0,
+        nesterov: bool = True,
+        inner_axis: str = "data",
+        outer_axis: str = DCN_AXIS,
+        outer: str = "on",
+    ):
+        sizes = axis_sizes(mesh)
+        for ax in (inner_axis, outer_axis):
+            if ax not in sizes:
+                raise ValueError(
+                    f"mesh has no axis {ax!r} (axes: {tuple(sizes)}); build "
+                    "it with two_tier_mesh()"
+                )
+        if sync_period < 1:
+            raise ValueError(f"sync_period must be >= 1, got {sync_period}")
+        if outer not in ("on", "off"):
+            raise ValueError(f"outer must be 'on' or 'off', got {outer!r}")
+        self.mesh = mesh
+        self.sync_period = sync_period
+        self.outer_lr = float(outer_lr)
+        self.outer_momentum = float(outer_momentum)
+        self.nesterov = nesterov
+        self.inner_axis = inner_axis
+        self.outer_axis = outer_axis
+        self.outer = outer
+        self.n_slices = sizes[outer_axis]
+        self.slice_world = sizes[inner_axis]
+        self.world = self.n_slices * self.slice_world
+
+    # ---- state / data placement -------------------------------------------
+
+    def init(self, state: Any) -> TwoTierState:
+        """Wrap an inner TrainState with zeroed outer momentum (same
+        structure and dtypes as ``params``; non-float leaves stay zeros
+        and are never updated — the outer optimizer only moves floats)."""
+        momentum = jax.tree.map(jnp.zeros_like, state.params)
+        return TwoTierState(inner=state, outer_momentum=momentum)
+
+    def replicate(self, tt_state: TwoTierState) -> TwoTierState:
+        from distributed_tensorflow_guide_tpu.core.compat import (
+            device_put_global,
+        )
+
+        sharding = NamedSharding(self.mesh, P())
+        return device_put_global(
+            tt_state, jax.tree.map(lambda _: sharding, tt_state)
+        )
+
+    def batch_spec(self, *, leading_time_axis: bool = True) -> P:
+        axes = (self.outer_axis, self.inner_axis)
+        return P(None, axes) if leading_time_axis else P(axes)
+
+    def shard_batch(self, batch: Any, *, leading_time_axis: bool = True):
+        """Place a host super-batch. Single-process: the full global
+        super-batch. Multi-process: this process's contiguous row block
+        (see :func:`~.elastic_world.shard_bounds`)."""
+        sharding = NamedSharding(
+            self.mesh, self.batch_spec(leading_time_axis=leading_time_axis)
+        )
+        if jax.process_count() > 1:
+            return jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(sharding, x),
+                batch,
+            )
+        return jax.device_put(batch, sharding)
+
+    # ---- accounting --------------------------------------------------------
+
+    def outer_float_bytes(self, tt_state: TwoTierState) -> int:
+        """Bytes the outer sync moves per slice per round: the float param
+        delta plus the float inner-optimizer state (what the two DCN
+        pmeans carry). Feed to ``benchmarks.common.outer_sync_bytes`` for
+        the ring-model per-device wire traffic."""
+        total = 0
+        for tree in (tt_state.inner.params, tt_state.inner.opt_state):
+            for leaf in jax.tree.leaves(tree):
+                if hasattr(leaf, "dtype") and _is_float(leaf):
+                    total += int(leaf.size) * leaf.dtype.itemsize
+        return total
+
+    # ---- the compiled outer round -----------------------------------------
+
+    def make_train_step(self, loss_fn: LossFn, *, donate: bool = True):
+        mu = self.outer_momentum
+
+        def sm_step(tt, batches):
+            state = tt.inner
+            anchor = state.params
+
+            def inner_step(carry, sub):
+                params, opt_state = carry
+                (loss, _), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params, sub
+                )
+                # dense sync DP *within the slice*: ICI-only collective
+                g = cc.pmean(g, self.inner_axis)
+                updates, opt_state = state.tx.update(g, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            (params, opt_state), losses = lax.scan(
+                inner_step, (anchor, state.opt_state), batches
+            )
+            momentum = tt.outer_momentum
+            if self.outer == "on":
+                delta = jax.tree.map(jnp.subtract, anchor, params)
+                # the ONLY collectives on the DCN tier: one param-delta
+                # pmean + the float opt-state pmean, per round
+                delta = _pmean_floats(delta, self.outer_axis)
+                momentum = jax.tree.map(
+                    lambda m, d: mu * m + d if _is_float(d) else m,
+                    tt.outer_momentum,
+                    delta,
+                )
+                if self.nesterov:
+                    update = jax.tree.map(
+                        lambda d, m: d + mu * m if _is_float(d) else d,
+                        delta,
+                        momentum,
+                    )
+                else:
+                    update = jax.tree.map(
+                        lambda d, m: m if _is_float(d) else d,
+                        delta,
+                        momentum,
+                    )
+                params = jax.tree.map(
+                    lambda a, u: a - self.outer_lr * u
+                    if _is_float(a) else a,
+                    anchor,
+                    update,
+                )
+                opt_state = _pmean_floats(opt_state, self.outer_axis)
+            new_inner = state.replace(
+                step=state.step + self.sync_period,
+                params=params,
+                opt_state=opt_state,
+            )
+            # outer="off" must be genuinely DCN-free — including the
+            # metric scalar (on real DCN one latency-bound round-trip per
+            # round would contaminate the bench's exposed-frac control),
+            # so its loss is the within-slice mean only
+            met_axes = ((self.outer_axis, self.inner_axis)
+                        if self.outer == "on" else self.inner_axis)
+            mets = {"loss": cc.pmean(losses.mean(), met_axes)}
+            return TwoTierState(new_inner, momentum), mets
+
+        sharded = shard_map(
+            sm_step,
+            mesh=self.mesh,
+            in_specs=(P(), self.batch_spec()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,) if donate else ())

@@ -26,6 +26,18 @@ Fault classes and where they fire:
                     only the CRC manifest can catch it)
 ==================  =========================================================
 
+World-kind faults are *process-group-targeted* — they change the capacity
+of the job instead of poisoning its stream — and fire through the elastic
+supervisor (train/elastic_world.py) rather than the step/data injectors:
+
+==================  =========================================================
+``slice_loss``      every process of slice ``param`` exits abruptly after
+                    completing step N — a lost slice (maintenance, preempted
+                    capacity); survivors resume at reduced world size
+``slice_return``    slice ``param`` becomes schedulable again at step N —
+                    the supervisor regrows to full world at that boundary
+==================  =========================================================
+
 Mid-save process kills are process-level, not stream-level: use
 ``runtime.multiprocess.MultiProcessRunner.kill`` directly (see the chaos
 tests). Every fault is one-shot — after it fires once it never fires again,
@@ -48,7 +60,13 @@ log = logging.getLogger("dtg.chaos")
 
 DATA_KINDS = ("nan_batch", "iterator_stall", "ckpt_truncate", "ckpt_corrupt")
 STEP_KINDS = ("step_exception",)
-KINDS = STEP_KINDS + DATA_KINDS
+# in-process injectable kinds — what wrap_step/inject_data (and
+# FaultSchedule.random's default draw) cover
+INJECTABLE_KINDS = STEP_KINDS + DATA_KINDS
+# world kinds change job capacity; they are applied by the elastic
+# supervisor (train/elastic_world.py), which marks them fired via fire()
+WORLD_KINDS = ("slice_loss", "slice_return")
+KINDS = INJECTABLE_KINDS + WORLD_KINDS
 
 
 class ChaosInjectedError(RuntimeError):
@@ -70,6 +88,19 @@ class Fault:
         if self.kind not in KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r} "
                              f"(choose from {KINDS})")
+        if self.kind in WORLD_KINDS:
+            # param targets the process group: the slice index
+            if self.param != int(self.param) or self.param < 0:
+                raise ValueError(
+                    f"{self.kind} needs param = a non-negative slice "
+                    f"index, got {self.param!r}")
+
+    @property
+    def slice_id(self) -> int:
+        """The targeted slice of a world-kind fault."""
+        if self.kind not in WORLD_KINDS:
+            raise ValueError(f"{self.kind!r} targets no slice")
+        return int(self.param)
 
 
 def _poison(batch: Any) -> Any:
@@ -150,12 +181,14 @@ class FaultSchedule:
 
     @classmethod
     def random(cls, seed: int, *, max_position: int,
-               kinds: Sequence[str] = KINDS, n_faults: int = 3,
+               kinds: Sequence[str] = INJECTABLE_KINDS, n_faults: int = 3,
                min_position: int = 1,
                stall_s: float = 0.5) -> "FaultSchedule":
         """Deterministic-in-``seed`` schedule: ``n_faults`` distinct
         positions in ``[min_position, max_position)``, kinds drawn
-        uniformly. Same seed → identical schedule, always."""
+        uniformly from the INJECTABLE kinds (world kinds need a slice
+        target — use :meth:`random_world`). Same seed → identical
+        schedule, always."""
         if max_position - min_position < n_faults:
             raise ValueError(
                 f"cannot place {n_faults} faults in "
@@ -172,9 +205,49 @@ class FaultSchedule:
             for p, k in zip(positions, chosen)
         ])
 
+    @classmethod
+    def random_world(cls, seed: int, *, n_slices: int, max_position: int,
+                     min_position: int = 1, min_gap: int = 2,
+                     ) -> "FaultSchedule":
+        """Deterministic-in-``seed`` capacity storm: one ``slice_loss`` /
+        ``slice_return`` pair targeting a random slice, the loss at a
+        random step and the return at least ``min_gap`` steps later (the
+        reduced-world window has to contain real training for the elastic
+        pins to mean anything). Same seed → identical schedule, always."""
+        if n_slices < 2:
+            raise ValueError(
+                f"need >= 2 slices to lose one, got {n_slices}")
+        if max_position - min_position <= min_gap:
+            raise ValueError(
+                f"cannot place a loss/return pair {min_gap} apart in "
+                f"[{min_position}, {max_position})")
+        rng = np.random.RandomState(seed)
+        target = int(rng.randint(0, n_slices))
+        loss_at = int(rng.randint(min_position, max_position - min_gap))
+        return_at = int(rng.randint(loss_at + min_gap, max_position))
+        return cls([
+            Fault("slice_loss", loss_at, float(target)),
+            Fault("slice_return", return_at, float(target)),
+        ])
+
     @property
     def pending(self) -> list[Fault]:
         return sorted(self._pending, key=lambda f: (f.position, f.kind))
+
+    def world_events(self) -> list[Fault]:
+        """Pending world-kind faults, soonest first — the elastic
+        supervisor's work queue."""
+        return [f for f in self.pending if f.kind in WORLD_KINDS]
+
+    def fire(self, fault: Fault) -> None:
+        """Mark an externally-applied fault fired (one-shot bookkeeping
+        for the world kinds, whose mechanism lives in the supervisor, not
+        in wrap_step/inject_data)."""
+        if fault not in self._pending:
+            raise ValueError(f"fault {fault} is not pending (already "
+                             "fired, or never scheduled)")
+        self._pending.discard(fault)
+        self.fired.append(fault)
 
     def _take(self, position: int, kinds: Sequence[str]) -> list[Fault]:
         due = [f for f in self._pending

@@ -26,3 +26,10 @@ from distributed_tensorflow_guide_tpu.train.evaluation import (  # noqa: F401
     Evaluator,
     EvalHook,
 )
+from distributed_tensorflow_guide_tpu.train.elastic_world import (  # noqa: F401
+    ElasticReport,
+    ElasticSupervisor,
+    ElasticWorldError,
+    shard_bounds,
+    verify_stream_accounting,
+)

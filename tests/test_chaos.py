@@ -273,3 +273,74 @@ def test_kill_mid_save_then_resume_bitwise(tmp_path):
     assert r["resumed_from"] in (0, 4)
     # ...and the final counter equals the uninterrupted 8-step run's
     assert r["w"] == 8.0
+
+
+# ---- world fault kinds (round 12) -------------------------------------------
+# slice_loss / slice_return are process-group-targeted and fire through the
+# elastic supervisor (train/elastic_world.py) — the schedule only does the
+# seeded planning + one-shot bookkeeping, pinned here; the end-to-end
+# kill/regrow pins live in tests/test_multislice.py.
+
+
+def test_world_kinds_validate_slice_target():
+    f = Fault("slice_loss", 5, 2.0)
+    assert f.slice_id == 2
+    with pytest.raises(ValueError, match="slice"):
+        Fault("slice_return", 5, 1.5)  # fractional slice id
+    with pytest.raises(ValueError, match="slice"):
+        Fault("slice_loss", 5, -1.0)
+    with pytest.raises(ValueError, match="targets no slice"):
+        Fault("step_exception", 5).slice_id
+
+
+def test_world_events_and_fire_are_one_shot():
+    loss = Fault("slice_loss", 3, 1.0)
+    ret = Fault("slice_return", 8, 1.0)
+    sched = FaultSchedule([loss, Fault("step_exception", 5), ret])
+    # world_events excludes the injectable kinds and sorts by position
+    assert sched.world_events() == [loss, ret]
+    sched.fire(loss)
+    assert sched.world_events() == [ret]
+    assert loss in sched.fired
+    with pytest.raises(ValueError, match="not pending"):
+        sched.fire(loss)  # one-shot: firing twice is a bug, loudly
+
+
+def test_injectors_never_consume_world_kinds(tmp_path):
+    """wrap_step/inject_data must pass world faults by: their mechanism is
+    the supervisor, and silently consuming them would erase a scheduled
+    capacity event."""
+    sched = FaultSchedule([Fault("slice_loss", 0, 0.0),
+                           Fault("slice_return", 1, 0.0)])
+    step = sched.wrap_step(_step_fn)
+    state, batch = _init(), jnp.zeros((4,))
+    data = sched.inject_data(_make_data, checkpoint_dir=tmp_path)(0)
+    for _ in range(3):
+        state, _ = step(state, next(data))
+    assert len(sched.world_events()) == 2 and not sched.fired
+
+
+def test_random_world_deterministic_and_ordered():
+    a = FaultSchedule.random_world(9, n_slices=4, max_position=30)
+    b = FaultSchedule.random_world(9, n_slices=4, max_position=30)
+    assert a.faults == b.faults
+    c = FaultSchedule.random_world(10, n_slices=4, max_position=30)
+    assert a.faults != c.faults
+    (loss, ret) = a.world_events()
+    assert loss.kind == "slice_loss" and ret.kind == "slice_return"
+    assert loss.slice_id == ret.slice_id  # the pair targets one slice
+    assert ret.position >= loss.position + 2  # the reduced window is real
+
+
+def test_random_default_draw_stays_injectable():
+    """random()'s default kinds must remain the in-process injectable five
+    — a world kind in a storm schedule would never fire through
+    wrap_step/inject_data and the storm pin would hang on it."""
+    from distributed_tensorflow_guide_tpu.testing.chaos import (
+        INJECTABLE_KINDS, WORLD_KINDS,
+    )
+
+    for seed in range(8):
+        sched = FaultSchedule.random(seed, max_position=40, n_faults=5)
+        assert all(f.kind in INJECTABLE_KINDS for f in sched.faults)
+        assert not any(f.kind in WORLD_KINDS for f in sched.faults)

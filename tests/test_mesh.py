@@ -135,3 +135,24 @@ def test_build_mesh_routes_multi_slice_to_hybrid():
     assert mesh.devices.shape == (4, 2, 1, 1, 1)
     slice_of = np.vectorize(lambda d: d.slice_index)(mesh.devices)
     assert np.all(slice_of[:2] == 0) and np.all(slice_of[2:] == 1)
+
+
+def test_valid_slice_counts_are_divisors():
+    from distributed_tensorflow_guide_tpu.core.mesh import valid_slice_counts
+
+    sizes = {"data": 12, "model": 2, "pipe": 1, "context": 1, "expert": 1}
+    assert valid_slice_counts(sizes, "data") == [1, 2, 3, 4, 6, 12]
+    assert valid_slice_counts(sizes, "model") == [1, 2]
+    with pytest.raises(ValueError, match="dcn_axis"):
+        valid_slice_counts(sizes, "bogus")
+
+
+def test_hybrid_divisibility_error_names_valid_counts():
+    """The error's advice is now programmatic: it quotes
+    valid_slice_counts() instead of leaving the caller to guess."""
+    from distributed_tensorflow_guide_tpu.core.mesh import hybrid_device_array
+
+    devs = _two_slice_devices()
+    sizes = {"data": 1, "model": 8, "pipe": 1, "context": 1, "expert": 1}
+    with pytest.raises(ValueError, match=r"slice counts \[1\]"):
+        hybrid_device_array(sizes, devs, 2, "data")

@@ -1,0 +1,402 @@
+"""Two-tier multi-slice strategy + elastic world supervision.
+
+The parity ladder (SURVEY.md §4 applied to the DCN tier):
+``sync_period=1`` ≡ sync DP (the LocalSGD pin, re-proved for the
+two-level reduction), the full outer round ≡ a host-side oracle of the
+same algebra, the DCN collectives fire once per round regardless of
+``sync_period`` — and at the top, the elastic acceptance pins: a seeded
+slice-loss/regrow run is bitwise reproducible and its stream accounting
+shows every sample consumed exactly once across the resize.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax.training import train_state
+
+import distributed_tensorflow_guide_tpu.collectives as cc
+from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+from distributed_tensorflow_guide_tpu.parallel.data_parallel import (
+    DataParallel,
+)
+from distributed_tensorflow_guide_tpu.parallel.multislice import (
+    DCN_AXIS,
+    MultiSliceLocalSGD,
+    TwoTierState,
+    two_tier_mesh,
+)
+from distributed_tensorflow_guide_tpu.testing.chaos import (
+    Fault,
+    FaultSchedule,
+)
+from distributed_tensorflow_guide_tpu.train.elastic_world import (
+    ElasticSupervisor,
+    elastic_toy_worker,
+    shard_bounds,
+    toy_spec,
+    verify_stream_accounting,
+)
+
+DIM = 6
+
+
+def _problem(seed=0, n=128):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, DIM).astype(np.float32)
+    w_true = rng.randn(DIM, 1).astype(np.float32)
+    return x, x @ w_true
+
+
+def _loss_aux(params, batch):
+    pred = batch["x"] @ params["w"]
+    return jnp.mean((pred - batch["y"]) ** 2), {}
+
+
+def _state(tx, seed=0):
+    rng = np.random.RandomState(100 + seed)
+    params = {"w": jnp.asarray(rng.randn(DIM, 1).astype(np.float32) * 0.1)}
+    return train_state.TrainState.create(apply_fn=None, params=params, tx=tx)
+
+
+def _superbatch(x, y, k, world_batch, seed=7):
+    idx = np.random.RandomState(seed).randint(0, len(x), k * world_batch)
+    return {
+        "x": x[idx].reshape(k, world_batch, DIM),
+        "y": y[idx].reshape(k, world_batch, 1),
+    }
+
+
+@pytest.fixture()
+def mesh22():
+    return two_tier_mesh(MeshSpec(), n_slices=2)
+
+
+# ---- mesh construction ------------------------------------------------------
+
+
+def test_two_tier_mesh_axes_and_contiguous_slices(mesh22):
+    assert mesh22.axis_names == (DCN_AXIS, "data", "model", "pipe",
+                                 "context", "expert")
+    assert mesh22.devices.shape == (2, 4, 1, 1, 1, 1)
+    # fake devices group contiguously by id: slice 0 = first 4 devices —
+    # the process→slice mapping the elastic harness assigns
+    ids = np.vectorize(lambda d: d.id)(mesh22.devices)
+    assert sorted(ids[0].ravel().tolist()) == [0, 1, 2, 3]
+    assert sorted(ids[1].ravel().tolist()) == [4, 5, 6, 7]
+
+
+def test_two_tier_mesh_rejects_nondivisible_slices():
+    with pytest.raises(ValueError, match="do not split"):
+        two_tier_mesh(MeshSpec(), n_slices=3)
+
+
+def test_two_tier_mesh_refuses_to_straddle_real_slices():
+    """When devices DO expose slice topology, a disagreeing n_slices must
+    raise — contiguous chunking would silently put the per-step inner
+    pmean across a real DCN boundary, the exact mistake the module
+    exists to prevent. (No-slice-info backends keep the fake split.)"""
+
+    class FakeDev:
+        def __init__(self, i):
+            self.id = i
+            self.slice_index = i // 4
+            self.process_index = 0
+            self.platform = "tpu"
+
+    devs = [FakeDev(i) for i in range(8)]  # 2 real slices of 4
+    mesh = two_tier_mesh(MeshSpec(), devices=devs, n_slices=2)
+    slice_of = np.vectorize(lambda d: d.slice_index)(mesh.devices)
+    assert np.all(slice_of[0] == 0) and np.all(slice_of[1] == 1)
+    with pytest.raises(ValueError, match="span 2 real slice"):
+        two_tier_mesh(MeshSpec(), devices=devs, n_slices=4)
+    with pytest.raises(ValueError, match="span 2 real slice"):
+        two_tier_mesh(MeshSpec(), devices=devs, n_slices=1)
+
+
+def test_strategy_requires_two_tier_axes(mesh8):
+    with pytest.raises(ValueError, match="two_tier_mesh"):
+        MultiSliceLocalSGD(mesh8, sync_period=1)
+
+
+# ---- parity ladder ----------------------------------------------------------
+
+
+def test_sync_period1_equals_sync_dp(mesh22, mesh8):
+    """sync_period=1, outer_lr=1, outer_momentum=0: the two-level
+    reduction (within-slice grad pmean, cross-slice param average) IS
+    sync DP — the LocalSGD period-1 pin, DCN-tier edition."""
+    x, y = _problem()
+    ms = MultiSliceLocalSGD(mesh22, sync_period=1)
+    dp = DataParallel(mesh8)
+    s_ms = ms.replicate(ms.init(_state(optax.sgd(0.05))))
+    s_dp = dp.replicate(_state(optax.sgd(0.05)))
+    step_ms = ms.make_train_step(_loss_aux, donate=False)
+    step_dp = dp.make_train_step(_loss_aux, donate=False)
+    for i in range(5):
+        sb = _superbatch(x, y, 1, 64, seed=7 + i)
+        s_ms, m_ms = step_ms(s_ms, ms.shard_batch(sb))
+        s_dp, m_dp = step_dp(
+            s_dp, dp.shard_batch({"x": sb["x"][0], "y": sb["y"][0]}))
+        assert float(m_ms["loss"]) == pytest.approx(
+            float(m_dp["loss"]), rel=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(s_ms.inner.params["w"]), np.asarray(s_dp.params["w"]),
+        rtol=1e-5)
+
+
+def test_outer_round_matches_host_oracle(mesh22):
+    """One compiled outer round ≡ the written-down algebra: per-slice
+    sync-DP SGD over the slice's contiguous row block, delta average
+    across slices, Nesterov outer update, on a single-mesh host oracle."""
+    x, y = _problem()
+    k, batch, mu, olr, ilr = 3, 64, 0.9, 0.7, 0.05
+    ms = MultiSliceLocalSGD(mesh22, sync_period=k, outer_lr=olr,
+                            outer_momentum=mu)
+    state = ms.replicate(ms.init(_state(optax.sgd(ilr))))
+    step = ms.make_train_step(_loss_aux, donate=False)
+
+    w = np.asarray(state.inner.params["w"]).astype(np.float64)
+    m = np.zeros_like(w)
+    for r in range(2):
+        sb = _superbatch(x, y, k, batch, seed=11 + r)
+        state, _ = step(state, ms.shard_batch(sb))
+
+        anchor = w.copy()
+        per_slice = []
+        for s in range(2):
+            lo, hi = shard_bounds(batch, 2, s)
+            ws = anchor.copy()
+            for j in range(k):
+                xs = sb["x"][j, lo:hi].astype(np.float64)
+                ys = sb["y"][j, lo:hi].astype(np.float64)
+                g = 2.0 * xs.T @ (xs @ ws - ys) / (xs.shape[0] * 1)
+                ws = ws - ilr * g
+            per_slice.append(ws)
+        delta = anchor - np.mean(per_slice, axis=0)
+        m = mu * m + delta
+        w = anchor - olr * (delta + mu * m)
+    np.testing.assert_allclose(
+        np.asarray(state.inner.params["w"]), w, rtol=1e-4)
+
+
+def test_outer_collectives_cross_dcn_once_per_round(mesh22):
+    """The bandwidth contract: param-sized DCN collectives fire once per
+    OUTER ROUND — the count must not scale with sync_period — while the
+    per-inner-step gradient pmean stays on the within-slice axis."""
+    x, y = _problem()
+
+    def dcn_calls(sync_period, outer="on"):
+        ms = MultiSliceLocalSGD(mesh22, sync_period, outer=outer)
+        state = ms.replicate(ms.init(_state(optax.sgd(0.05))))
+        with cc.trace_comm() as rec:
+            step = ms.make_train_step(_loss_aux, donate=False)
+            step.lower(state, ms.shard_batch(
+                _superbatch(x, y, sync_period, 64)))
+        return {key: n for key, n in rec.calls.items()}
+
+    c1, c4 = dcn_calls(1), dcn_calls(4)
+    assert c1[f"pmean[{DCN_AXIS}]"] > 0
+    # one outer sync per round at ANY period: identical DCN call count
+    assert c1[f"pmean[{DCN_AXIS}]"] == c4[f"pmean[{DCN_AXIS}]"]
+    # the dense per-step gradient reduction rides the within-slice axis
+    assert c4["pmean[data]"] > 0
+    # outer="off" (the bench's timing control) emits NO collective that
+    # touches the DCN axis — not even the metric scalar, whose per-round
+    # latency would contaminate the exposed-frac control on real DCN
+    assert not any(DCN_AXIS in key for key in dcn_calls(4, outer="off"))
+
+
+def test_outer_sync_bytes_closed_form():
+    from benchmarks.common import outer_sync_bytes
+
+    assert outer_sync_bytes(100.0, 1) == 0.0
+    assert outer_sync_bytes(100.0, 4) == pytest.approx(2 * 100 * 3 / 4)
+
+
+def test_outer_float_bytes_counts_params_and_float_opt_state(mesh22):
+    # sgd without momentum: float state = params only (6*1 f32 = 24B)
+    ms = MultiSliceLocalSGD(mesh22, 1)
+    assert ms.outer_float_bytes(ms.init(_state(optax.sgd(0.05)))) == 24
+    # with momentum: + the f32 trace (another 24B)
+    assert ms.outer_float_bytes(
+        ms.init(_state(optax.sgd(0.05, momentum=0.9)))) == 48
+
+
+def test_two_tier_state_is_a_pytree(mesh22):
+    ms = MultiSliceLocalSGD(mesh22, 1)
+    tt = ms.init(_state(optax.sgd(0.05)))
+    leaves, treedef = jax.tree_util.tree_flatten(tt)
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert isinstance(rebuilt, TwoTierState)
+    np.testing.assert_array_equal(
+        np.asarray(rebuilt.inner.params["w"]),
+        np.asarray(tt.inner.params["w"]))
+
+
+# ---- deterministic re-split + exactly-once accounting -----------------------
+
+
+def test_shard_bounds_tile_disjointly():
+    for total in (8, 7, 12):
+        for n in (1, 2, 3, 5):
+            spans = [shard_bounds(total, n, r) for r in range(n)]
+            pos = 0
+            for lo, hi in spans:
+                assert lo == pos
+                pos = hi
+            assert pos == total
+    with pytest.raises(ValueError):
+        shard_bounds(8, 2, 2)
+
+
+def test_verify_stream_accounting_resize_and_replays():
+    """The exactly-once verdict: a resize (different tiling per world),
+    in-generation replays (later record wins) and superseded crashed-
+    generation work all pass; gaps, overlaps and missing rounds fail."""
+    B = 8
+
+    def rec(gen, rnd, sl, lo, hi):
+        return {"gen": gen, "round": rnd, "slice": sl, "lo": lo, "hi": hi}
+
+    good = [
+        # gen 0: two slices, rounds 0-2; round 2's work is superseded
+        rec(0, 0, 0, 0, 4), rec(0, 0, 1, 4, 8),
+        rec(0, 1, 0, 0, 4), rec(0, 1, 1, 4, 8),
+        rec(0, 2, 0, 0, 4), rec(0, 2, 1, 4, 8),
+        # gen 1 (reduced world): rounds 2-3 at the new tiling
+        rec(1, 2, 0, 0, 8), rec(1, 3, 0, 0, 8),
+    ]
+    ok, problems = verify_stream_accounting(good, 4, B)
+    assert ok, problems
+
+    # in-generation replay of round 3: the later record wins, still ok
+    replay = good + [rec(1, 3, 0, 0, 8)]
+    ok, _ = verify_stream_accounting(replay, 4, B)
+    assert ok
+
+    gap = good[:-1] + [rec(1, 3, 0, 0, 6)]
+    ok, problems = verify_stream_accounting(gap, 4, B)
+    assert not ok and any("dropped" in p for p in problems)
+
+    overlap = good + [rec(1, 3, 1, 2, 8)]
+    ok, problems = verify_stream_accounting(overlap, 4, B)
+    assert not ok and any("duplicated" in p for p in problems)
+
+    ok, problems = verify_stream_accounting(good, 5, B)
+    assert not ok and any("never consumed" in p for p in problems)
+
+
+# ---- elastic supervision over real processes --------------------------------
+
+pytestmark_mp = pytest.mark.chaos
+
+
+@pytest.mark.chaos
+def test_elastic_supervisor_clean_run_matches_oracle(tmp_path):
+    """A fault-free supervised run over 2 one-process slices ends at the
+    host oracle of the same two-tier algebra — pinning the whole worker
+    stack (step-keyed stream, contiguous re-split, two-tier step) across
+    real process boundaries."""
+    spec = toy_spec(total_steps=4, ckpt_every=2, sync_period=2,
+                    global_batch=8, dim=4, seed=5)
+    sup = ElasticSupervisor(
+        FaultSchedule([]), n_slices=2, procs_per_slice=1,
+        base_spec=spec, ckpt_dir=tmp_path / "ckpt",
+        workdir=tmp_path / "work", timeout=150,
+    )
+    rep = sup.run()
+    assert [e["outcome"] for e in rep.timeline] == ["clean"]
+    ok, problems = rep.accounting(4, 8)
+    assert ok, problems
+
+    # host oracle of elastic_toy_worker's trajectory
+    gt = np.random.RandomState(5)
+    w_true = gt.randn(4, 1).astype(np.float32)
+    w = np.zeros((4, 1), np.float64)
+    for r in range(4):
+        anchor = w.copy()
+        per_slice = []
+        for s in range(2):
+            lo, hi = shard_bounds(8, 2, s)
+            ws = anchor.copy()
+            for k in range(2):
+                rng = np.random.RandomState(
+                    np.asarray([5, r, k], dtype=np.uint32))
+                x = rng.randn(8, 4).astype(np.float32)
+                y = x @ w_true
+                xs = x[lo:hi].astype(np.float64)
+                ys = y[lo:hi].astype(np.float64)
+                g = 2.0 * xs.T @ (xs @ ws - ys) / xs[..., :1].size
+                ws = ws - 0.05 * g
+            per_slice.append(ws)
+        w = anchor - (anchor - np.mean(per_slice, axis=0))
+    np.testing.assert_allclose(
+        np.asarray(rep.final_params), w.reshape(-1), rtol=1e-4)
+
+
+def _elastic_run(tmp_path, tag):
+    sched = FaultSchedule([Fault("slice_loss", 5, 1.0),
+                           Fault("slice_return", 10, 1.0)])
+    sup = ElasticSupervisor(
+        sched, n_slices=2, procs_per_slice=2,
+        base_spec=toy_spec(total_steps=16, ckpt_every=4, sync_period=2,
+                           global_batch=8, dim=4, seed=3,
+                           outer_momentum=0.9, outer_lr=0.7),
+        ckpt_dir=tmp_path / tag / "ckpt", workdir=tmp_path / tag / "work",
+        timeout=150, failure_grace=5.0,
+    )
+    return sup.run(), sched
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_slice_loss_resize_regrow_bitwise_and_exactly_once(tmp_path):
+    """The round-12 acceptance pin: slice 1 dies after step 5 (all of its
+    processes, group-targeted), training continues at reduced world
+    within one restore, regrows at step 10, and finishes — with every
+    stream index consumed exactly once across both resizes, and two
+    identically-seeded runs bitwise identical to each other."""
+    rep1, sched1 = _elastic_run(tmp_path, "a")
+    outcomes = [e["outcome"] for e in rep1.timeline]
+    assert outcomes == ["slice_loss", "clean", "clean"]
+    # reduced world really trained (one-generation recovery, not a stall)
+    assert rep1.timeline[1]["live"] == [0]
+    assert rep1.timeline[1].get("returned") == [1]
+    assert rep1.timeline[2]["live"] == [0, 1]
+    # both world faults fired exactly once
+    assert sched1.world_events() == []
+    assert {f.kind for f in sched1.fired} == {"slice_loss", "slice_return"}
+    # one resize, one measured recovery
+    assert len(rep1.mttr_s) == 1 and rep1.mttr_s[0] > 0
+    # exactly-once data accounting across the resize
+    ok, problems = rep1.accounting(16, 8)
+    assert ok, problems
+    # final state identical on every worker of the final generation
+    ws = [r.result["w"] for r in rep1.results]
+    assert all(w == ws[0] for w in ws)
+
+    rep2, _ = _elastic_run(tmp_path, "b")
+    assert rep2.final_params == rep1.final_params  # bitwise, run vs run
+    assert [e["outcome"] for e in rep2.timeline] == outcomes
+
+
+@pytest.mark.chaos
+def test_supervisor_raises_on_unscheduled_failure(tmp_path):
+    """A generation that dies WITHOUT a scheduled slice loss is a real
+    failure — the supervisor must surface it, not shrink the world."""
+    from distributed_tensorflow_guide_tpu.train.elastic_world import (
+        ElasticWorldError,
+    )
+
+    # 2 slices but a batch that cannot split over the devices: every
+    # worker raises at startup, no loss marker is ever written
+    spec = toy_spec(total_steps=4, ckpt_every=2, global_batch=3)
+    sup = ElasticSupervisor(
+        FaultSchedule([]), n_slices=2, procs_per_slice=1,
+        base_spec=spec, ckpt_dir=tmp_path / "ckpt",
+        workdir=tmp_path / "work", timeout=120, failure_grace=3.0,
+    )
+    with pytest.raises(ElasticWorldError, match="without a scheduled"):
+        sup.run()
