@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Contract-linter liveness bench: the full registry audit, timed.
+
+This is the subprocess that makes ``dtg-lint`` part of tier-1: it forces
+the pinned 8-fake-CPU-device geometry, traces EVERY registered
+:class:`~distributed_tensorflow_guide_tpu.analysis.contracts.ProgramContract`
+and runs all five rule families — exactly what the standalone CLI does —
+then emits the one-line JSON contract. ``value`` is the number of clean
+programs; rc is 1 if any program violates its contract, so a lint
+regression fails the smoke suite (and tests/test_benchmarks.py) loudly.
+
+Lint is trace-time only (nothing compiles, nothing executes), so this is
+a liveness + wall-clock check, not a throughput number: ``lint_seconds``
+is reported so a pathological trace blowup shows up in the log.
+"""
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.common import device_setup, report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fake-devices", type=int, default=8,
+                    help="virtual CPU devices (contracts are pinned at 8)")
+    ap.add_argument("--small", action="store_true",
+                    help="accepted for smoke-suite parity (lint programs "
+                         "are already toy-scale; no-op)")
+    args, _unknown = ap.parse_known_args()
+
+    device_setup(args.fake_devices or 8)
+    from distributed_tensorflow_guide_tpu.analysis import lint
+
+    t0 = time.perf_counter()
+    rep = lint.run_lint()
+    dt = time.perf_counter() - t0
+    if not rep.ok:
+        print(lint.render_text(rep), file=sys.stderr)
+    report("lint_programs_pass", float(sum(p.ok for p in rep.programs)),
+           "programs",
+           n_programs=len(rep.programs),
+           n_findings=rep.n_findings,
+           lint_seconds=round(dt, 2))
+    return 0 if rep.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

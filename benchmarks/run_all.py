@@ -30,6 +30,7 @@ BENCHES = [
     "bench_comm_overlap.py",  # ICI overlap: exposed-comm fraction A/B
     "bench_resilience.py",    # checkpoint overhead + MTTR/goodput (CPU-real)
     "bench_dcn_hybrid.py",    # two-tier DCN sync tradeoff + elastic resize
+    "bench_lint.py",          # contract linter: full program-registry audit
 ]
 
 # Tiny fake-device configs, small enough for CPU (also used by
@@ -122,6 +123,11 @@ SMOKE = {
         # eat the tier-1 wall-clock budget for coverage tier-1 already
         # has)
         ["--fake-devices", "8", "--small", "--seed", "0"],
+    "bench_lint.py":
+        # NOT a liveness stub either: lint is trace-time only, so the
+        # smoke run IS the full registry audit at the pinned 8-device
+        # geometry — this line is what puts dtg-lint inside tier-1
+        ["--fake-devices", "8"],
 }
 
 

@@ -1,0 +1,22 @@
+"""Program-contract static analysis (round 13).
+
+The pin idiom of tests/pin_utils.py — walk a jaxpr, count something,
+assert — grown into a subsystem, the way every kernel registers into
+``ops/autotune.py``: each judged entry point registers a declarative
+:class:`~.contracts.ProgramContract`, and ``python -m
+distributed_tensorflow_guide_tpu.analysis.lint`` traces every registered
+program on CPU fake devices and audits it against five rule families
+(memory, precision, collectives, donation, determinism). Trace-time only
+— the linter observes programs, it never rewrites them (docs/analysis.md).
+
+Import discipline: this package must stay importable before jax device
+configuration happens (the CLI sets up fake CPU devices itself), so this
+module performs no jax work at import time.
+"""
+
+from distributed_tensorflow_guide_tpu.analysis.contracts import (  # noqa: F401
+    DonationSpec,
+    ProgramContract,
+    register,
+    registered_contracts,
+)

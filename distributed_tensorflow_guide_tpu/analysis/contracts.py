@@ -1,0 +1,117 @@
+"""Declarative program contracts + the global registry.
+
+A :class:`ProgramContract` is what a subsystem *promises* about one of its
+compiled entry programs — which collectives it emits on which axes, what
+its precision policy is, what it donates, that it never materializes a
+(rows, V) logits tensor, that it is host-callback-free. Subsystems expose
+their contracts from a ``lint_contracts()`` module function (the autotune
+pattern: the subsystem owns its table entries); ``analysis.programs``
+aggregates them into the registry the CLI and tier-1 audit run over.
+
+Import discipline: no jax at module import — ``build`` callables do all
+jax work lazily, so the lint CLI can configure fake CPU devices first.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+#: build() -> (fn, args): the traceable program and example (or abstract
+#: ShapeDtypeStruct) arguments jax.make_jaxpr is called with.
+BuildFn = Callable[[], tuple[Callable, tuple]]
+
+
+@dataclasses.dataclass(frozen=True)
+class DonationSpec:
+    """What the program declares about buffer donation.
+
+    ``argnums`` are positions in the *pre-flattening* argument list.
+    ``mode``:
+
+    * ``"alias"`` — every donated leaf must be shape/dtype-matchable to
+      an output leaf (XLA input-output alias feasibility), the train-step
+      state->state pattern.
+    * ``"scratch"`` — the donated buffer never comes back out (the decode
+      KV cache: the program returns tokens only, donation frees the input
+      for in-place reuse); only the liveness checks apply — the buffer
+      must be read at least once and referenced at most once at top level.
+    """
+
+    argnums: tuple[int, ...]
+    mode: str = "alias"
+
+    def __post_init__(self):
+        if self.mode not in ("alias", "scratch"):
+            raise ValueError(
+                f"donation mode must be 'alias' or 'scratch', "
+                f"got {self.mode!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramContract:
+    """One judged entry program and everything the rules check it against.
+
+    ``collectives`` maps ``"prim[axis,...]"`` census keys (walker
+    spelling: ``psum``, ``all_gather``, ``psum_scatter``, ``ppermute``)
+    to an exact count or an inclusive ``(lo, hi)`` range. With
+    ``strict_collectives`` (default) any *unlisted* collective observed
+    in the trace is a violation — the "extra stray psum" failure mode.
+    An empty dict therefore declares a collective-free program.
+
+    ``policy`` names a core/precision.py preset (or is a Policy): matmul
+    operands must be in its compute dtype and large contractions /
+    reductions must accumulate in its accum dtype.
+
+    ``vocab_dim`` arms the vocab-materialization rule: no f32
+    (rows >= vocab_rows, ..., vocab_dim) intermediate bigger than
+    ``max_vocab_f32_elems`` may exist anywhere in the trace.
+
+    ``sources`` lists the module names whose edits should re-trigger this
+    contract under ``lint --changed-only``.
+    """
+
+    name: str
+    build: BuildFn
+    policy: Any = "f32"
+    collectives: dict[str, Any] | None = None
+    strict_collectives: bool = True
+    vocab_dim: int | None = None
+    vocab_rows: int = 1
+    max_vocab_f32_elems: int = 0
+    max_f32_intermediate_elems: int | None = None
+    donation: DonationSpec | None = None
+    allowed_callbacks: tuple[str, ...] = ()
+    sources: tuple[str, ...] = ()
+    notes: str = ""
+
+
+_REGISTRY: dict[str, ProgramContract] = {}
+
+
+def register(contract: ProgramContract) -> ProgramContract:
+    """Add one contract to the global registry (idempotent per name —
+    re-registering the same name replaces, so provider modules can be
+    re-imported in long-lived test processes)."""
+    _REGISTRY[contract.name] = contract
+    return contract
+
+
+def registered_contracts(
+    names: tuple[str, ...] | list[str] | None = None,
+) -> list[ProgramContract]:
+    """Registry contents (deterministic registration order). ``names``
+    filters — an unknown name is an error, not an empty result."""
+    if names is None:
+        return list(_REGISTRY.values())
+    unknown = [n for n in names if n not in _REGISTRY]
+    if unknown:
+        raise KeyError(
+            f"unknown program(s) {unknown}; registered: "
+            f"{sorted(_REGISTRY)}")
+    return [_REGISTRY[n] for n in names]
+
+
+def clear_registry() -> None:
+    """Test isolation hook (tests/test_analysis.py scratch registries)."""
+    _REGISTRY.clear()

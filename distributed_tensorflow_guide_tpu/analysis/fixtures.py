@@ -1,0 +1,61 @@
+"""Tiny shared programs the contract providers trace.
+
+Lint runs inside tier-1's wall-clock budget, so every registered program
+is built at toy scale: a 4-leaf MLP for the DP/FSDP/multislice strategy
+contracts (dims chosen so FSDP shards the two matrices over 8 devices and
+replicates the two biases) and an 8-token 2-layer transformer for the
+pipeline/decode contracts. The contracts audit *structure* — collectives,
+dtypes, donation, shapes — which is scale-invariant; correctness at real
+scale stays with the subsystem test suites.
+"""
+
+from __future__ import annotations
+
+
+def tiny_mlp():
+    """(loss_fn, state, batch): 4 param leaves — w1 (16,32) and w2 (32,16)
+    shard over 8 devices at min_shard_size=64; b1 (32,) and b2 (16,) stay
+    replicated — with an SGD+momentum optimizer so the optimizer state
+    carries float leaves (what the multislice outer sync pmeans)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax.training import train_state
+
+    def loss_fn(params, batch):
+        h = jnp.tanh(batch["x"] @ params["w1"] + params["b1"])
+        pred = h @ params["w2"] + params["b2"]
+        err = pred - batch["y"]
+        return jnp.mean(err ** 2), {"mae": jnp.mean(jnp.abs(err))}
+
+    k = jax.random.PRNGKey(0)
+    ks = jax.random.split(k, 4)
+    params = {
+        "w1": jax.random.normal(ks[0], (16, 32), jnp.float32) * 0.1,
+        "b1": jnp.zeros((32,), jnp.float32),
+        "w2": jax.random.normal(ks[1], (32, 16), jnp.float32) * 0.1,
+        "b2": jnp.zeros((16,), jnp.float32),
+    }
+    state = train_state.TrainState.create(
+        apply_fn=lambda *a, **kw: None, params=params,
+        tx=optax.sgd(0.1, momentum=0.9))
+    batch = {
+        "x": jax.random.normal(ks[2], (8, 16), jnp.float32),
+        "y": jax.random.normal(ks[3], (8, 16), jnp.float32),
+    }
+    return loss_fn, state, batch
+
+
+def tiny_lm_cfg(**overrides):
+    """The toy TransformerConfig the pipeline/decode contracts trace
+    (f32 on CPU, dense attention at this length)."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        TransformerConfig,
+    )
+
+    kw = dict(vocab_size=64, num_layers=2, num_heads=2, d_model=16,
+              d_ff=32, max_len=8, causal=True, dtype=jnp.float32)
+    kw.update(overrides)
+    return TransformerConfig(**kw)

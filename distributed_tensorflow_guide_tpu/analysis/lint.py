@@ -1,0 +1,283 @@
+"""The contract linter: trace every registered program, run every rule.
+
+``python -m distributed_tensorflow_guide_tpu.analysis.lint`` (or the
+``dtg-lint`` console script) configures 8 fake CPU devices, imports the
+provider modules (``analysis/programs.py``), traces each registered
+:class:`~.contracts.ProgramContract` with ``jax.make_jaxpr`` — trace-time
+only, nothing is compiled or executed, so lint is perf-neutral by
+construction — and audits the jaxpr with the five rule families in
+``analysis/rules.py``. Exit status 1 on any violation; the report (text
+or ``--json``) carries the expected-vs-observed diff per finding.
+
+``--changed-only`` maps ``git diff --name-only <base>`` (plus the working
+tree) onto each contract's ``sources`` so a small edit lints in seconds;
+any edit under ``analysis/`` re-lints everything, and when git state is
+unreadable the mode falls back to the full audit rather than passing
+vacuously.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import traceback
+from typing import Any
+
+LINT_DEVICES = 8  # the tier-1 fake-mesh size every expectation is pinned at
+
+
+def _ensure_cpu_devices(n: int = LINT_DEVICES) -> None:
+    """Fake CPU devices for standalone runs. Importing this package already
+    imports jax, but the *backend* only materializes at the first
+    ``jax.devices()`` — until then the device count is still configurable
+    (0.4.x reads the XLA flag at client creation; ≥0.5 has the config).
+    If a backend is already live (pytest / bench harness), that caller's
+    device setup wins — contracts are pinned at 8 devices either way
+    (tests/conftest.py uses 8 too)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_NUM_CPU_DEVICES", str(n))
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    import jax
+
+    from distributed_tensorflow_guide_tpu.core import compat
+
+    try:
+        from jax._src import xla_bridge
+        if xla_bridge.backends_are_initialized():
+            return
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    compat.set_cpu_device_count(n)
+
+
+# ---- tracing + rule execution ----------------------------------------------
+
+
+@dataclasses.dataclass
+class ProgramReport:
+    name: str
+    ok: bool
+    rules: list
+    error: str | None = None
+    notes: str = ""
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "ok": self.ok,
+                "rules": [r.to_dict() for r in self.rules],
+                "error": self.error, "notes": self.notes}
+
+
+@dataclasses.dataclass
+class LintReport:
+    programs: list
+
+    @property
+    def ok(self) -> bool:
+        return all(p.ok for p in self.programs)
+
+    @property
+    def n_findings(self) -> int:
+        return sum(len(r.findings) for p in self.programs for r in p.rules)
+
+    def to_dict(self) -> dict:
+        return {"ok": self.ok, "n_programs": len(self.programs),
+                "n_pass": sum(p.ok for p in self.programs),
+                "n_findings": self.n_findings,
+                "programs": [p.to_dict() for p in self.programs]}
+
+
+def _leaf_avals(arg: Any) -> list:
+    import jax
+    import jax.numpy as jnp
+
+    return [jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+            for x in jax.tree.leaves(arg)]
+
+
+def lint_contract(contract) -> ProgramReport:
+    """Trace one contract's program and run every rule family over it."""
+    import jax
+
+    from distributed_tensorflow_guide_tpu.analysis import rules
+
+    try:
+        fn, args = contract.build()
+        jaxpr = jax.make_jaxpr(fn)(*args)
+        traced = rules.TracedProgram(
+            name=contract.name, jaxpr=jaxpr,
+            arg_leaf_avals=[_leaf_avals(a) for a in args])
+    except Exception:  # a broken build must FAIL lint, not crash it
+        return ProgramReport(contract.name, ok=False, rules=[],
+                             error=traceback.format_exc(limit=8),
+                             notes=contract.notes)
+    reports = [rule(traced, contract) for rule in rules.ALL_RULES]
+    return ProgramReport(contract.name,
+                         ok=all(r.ok for r in reports),
+                         rules=reports, notes=contract.notes)
+
+
+def run_contracts(contracts) -> LintReport:
+    return LintReport([lint_contract(c) for c in contracts])
+
+
+# ---- registry + --changed-only selection ------------------------------------
+
+
+def _registered(names=None):
+    from distributed_tensorflow_guide_tpu.analysis import (  # noqa: F401
+        programs,  # import for side effect: providers register
+    )
+    from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        registered_contracts,
+    )
+
+    return registered_contracts(names)
+
+
+def _changed_files(base: str) -> list[str] | None:
+    """Repo-relative changed paths (committed-vs-base + working tree), or
+    None when git can't answer (then the caller lints everything)."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    out: set[str] = set()
+    for cmd in (["git", "diff", "--name-only", base],
+                ["git", "status", "--porcelain"]):
+        try:
+            r = subprocess.run(cmd, cwd=root, capture_output=True,
+                               text=True, timeout=30)
+        except Exception:
+            return None
+        if r.returncode != 0:
+            return None
+        for line in r.stdout.splitlines():
+            path = line[3:] if cmd[1] == "status" else line
+            if path.strip():
+                out.add(path.strip().split(" -> ")[-1])
+    return sorted(out)
+
+
+def _module_path(mod_name: str) -> str | None:
+    import importlib.util
+
+    try:
+        spec = importlib.util.find_spec(mod_name)
+    except (ImportError, ValueError):
+        return None
+    return spec.origin if spec else None
+
+
+def select_changed(contracts, base: str) -> tuple[list, str]:
+    """The subset of ``contracts`` whose ``sources`` intersect the changed
+    files; an analysis/-layer change (or unreadable git) selects all."""
+    changed = _changed_files(base)
+    if changed is None:
+        return list(contracts), "git unreadable -> full lint"
+    changed_abs = {os.path.basename(c): c for c in changed}
+    if any("/analysis/" in c or c.startswith("analysis/") for c in changed):
+        return list(contracts), "analysis/ changed -> full lint"
+    picked = []
+    for c in contracts:
+        hit = False
+        for mod in c.sources:
+            path = _module_path(mod)
+            if path and os.path.basename(path) in changed_abs:
+                hit = True
+                break
+        if hit:
+            picked.append(c)
+    return picked, f"{len(changed)} changed file(s)"
+
+
+def run_lint(names=None, changed_only: bool = False,
+             base: str = "HEAD") -> LintReport:
+    contracts = _registered(tuple(names) if names else None)
+    if changed_only:
+        contracts, _why = select_changed(contracts, base)
+    return run_contracts(contracts)
+
+
+# ---- rendering --------------------------------------------------------------
+
+
+def render_text(report: LintReport, verbose: bool = False) -> str:
+    lines = []
+    for p in report.programs:
+        status = "PASS" if p.ok else "FAIL"
+        lines.append(f"{status:4}  {p.name}")
+        if p.error:
+            lines.append("      trace error:")
+            lines.extend("      | " + ln
+                         for ln in p.error.strip().splitlines()[-6:])
+            continue
+        for r in p.rules:
+            if verbose or not r.ok:
+                obs = ", ".join(f"{k}={v}" for k, v in r.observed.items())
+                lines.append(f"      {r.rule:12} {'ok' if r.ok else 'FAIL'}"
+                             f"  [{obs}]")
+            for f in r.findings:
+                lines.append(f"        - {f.message}")
+                lines.append(f"          expected: {f.expected!r}   "
+                             f"observed: {f.observed!r}")
+    lines.append(
+        f"{'PASS' if report.ok else 'FAIL'}: "
+        f"{sum(p.ok for p in report.programs)}/{len(report.programs)} "
+        f"programs clean, {report.n_findings} finding(s)")
+    return "\n".join(lines)
+
+
+# ---- CLI --------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dtg-lint",
+        description="Audit every registered compiled program against its "
+                    "declared contract (trace-only, CPU fake devices).")
+    parser.add_argument("--programs", default=None,
+                        help="comma-separated program names (default: all)")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="lint only contracts whose source modules "
+                             "changed vs --base / the working tree")
+    parser.add_argument("--base", default="HEAD",
+                        help="git ref --changed-only diffs against")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered programs and exit")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="show per-rule observations for passing rules")
+    args = parser.parse_args(argv)
+
+    _ensure_cpu_devices()
+    names = args.programs.split(",") if args.programs else None
+    if args.list:
+        for c in _registered(None):
+            print(f"{c.name:32} sources={','.join(c.sources)}")
+        return 0
+    contracts = _registered(tuple(names) if names else None)
+    if args.changed_only:
+        contracts, why = select_changed(contracts, args.base)
+        if not args.json:
+            print(f"--changed-only: {why}; linting "
+                  f"{len(contracts)}/{len(_registered(None))} program(s)")
+        if not contracts:
+            print("nothing to lint")
+            return 0
+    report = run_contracts(contracts)
+    if args.json:
+        print(json.dumps(report.to_dict()))
+    else:
+        print(render_text(report, verbose=args.verbose))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,38 @@
+"""Registry population: import every provider, register every contract.
+
+Each subsystem that ships a judged entry point exposes a module-level
+``lint_contracts() -> list[ProgramContract]`` next to the code it audits
+(the contract lives WITH the program, not in a central manifest — adding
+a subsystem means adding a provider function, not editing this package).
+This module is the one aggregation point: importing it (which
+``lint._registered`` does for its side effect) registers everything.
+
+Provider failures are deliberately NOT swallowed: a provider that cannot
+even build its contract list is a lint failure in its own right, and the
+ImportError propagating out of ``dtg-lint`` is the report.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from distributed_tensorflow_guide_tpu.analysis.contracts import register
+
+PROVIDER_MODULES = (
+    "distributed_tensorflow_guide_tpu.parallel.data_parallel",
+    "distributed_tensorflow_guide_tpu.parallel.fsdp",
+    "distributed_tensorflow_guide_tpu.parallel.pipeline",
+    "distributed_tensorflow_guide_tpu.parallel.multislice",
+    "distributed_tensorflow_guide_tpu.ops.fused_ce",
+    "distributed_tensorflow_guide_tpu.models.generation",
+)
+
+
+def load_all() -> None:
+    for mod_name in PROVIDER_MODULES:
+        mod = importlib.import_module(mod_name)
+        for contract in mod.lint_contracts():
+            register(contract)
+
+
+load_all()

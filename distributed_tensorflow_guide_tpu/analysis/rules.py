@@ -1,0 +1,329 @@
+"""The five rule families the linter runs over every traced program.
+
+Each rule is a pure function ``(traced, contract) -> RuleReport``: it
+reads the jaxpr (never rewrites it), records what it *observed* — so a
+passing report is still evidence, not silence — and emits a
+:class:`Finding` per violation with the expected/observed pair the report
+renderer turns into a diff.
+
+Rule families (docs/analysis.md has the catalog with rationale):
+
+1. **memory**    — largest live f32 intermediate + the vocab-dim
+   materialization cap generalizing the fused-CE "no full logits" pin.
+2. **precision** — matmul operand dtypes and accumulation dtypes must
+   conform to the contract's core/precision.py Policy.
+3. **collectives** — census of communication primitives per mesh axis vs
+   the declared expectations; strict mode flags unlisted collectives.
+4. **donation**  — declared donated buffers are actually donatable
+   (alias-feasible or scratch), read at least once, referenced at most
+   once at top level (invar aliasing counted).
+5. **determinism** — no host callbacks / nondeterministic-lowering
+   primitives inside step functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+from distributed_tensorflow_guide_tpu.analysis import walker
+from distributed_tensorflow_guide_tpu.analysis.contracts import (
+    ProgramContract,
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One violation: ``expected`` vs ``observed`` renders as the diff."""
+
+    rule: str
+    message: str
+    expected: Any = None
+    observed: Any = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class RuleReport:
+    rule: str
+    observed: dict
+    findings: list[Finding]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "ok": self.ok, "observed": self.observed,
+                "findings": [f.to_dict() for f in self.findings]}
+
+
+@dataclasses.dataclass
+class TracedProgram:
+    """A contract's program after tracing: the closed jaxpr plus the
+    per-argument flat input avals the donation rule needs (jaxpr invars
+    are flat; ``arg_leaf_avals[i]`` is argument i's slice of them)."""
+
+    name: str
+    jaxpr: Any  # jax.extend.core.ClosedJaxpr
+    arg_leaf_avals: list[list[Any]]
+
+
+# ---- 1. memory --------------------------------------------------------------
+
+
+def rule_memory(traced: TracedProgram,
+                contract: ProgramContract) -> RuleReport:
+    elems, shape = walker.largest_f32_intermediate(traced.jaxpr)
+    observed = {"largest_f32_elems": elems, "largest_f32_shape": list(shape)}
+    findings = []
+    cap = contract.max_f32_intermediate_elems
+    if cap is not None and elems > cap:
+        findings.append(Finding(
+            "memory",
+            f"largest f32 intermediate {shape} has {elems} elements, over "
+            f"the declared cap",
+            expected=f"<= {cap} elements", observed=elems))
+    if contract.vocab_dim is not None:
+        worst = walker.max_f32_elems_with_vocab_dim(
+            traced.jaxpr, contract.vocab_rows, contract.vocab_dim)
+        observed["vocab_materialized_elems"] = worst
+        if worst > contract.max_vocab_f32_elems:
+            findings.append(Finding(
+                "memory",
+                f"f32 (rows>={contract.vocab_rows}, ..., "
+                f"V={contract.vocab_dim}) logits-shaped intermediate "
+                "materialized",
+                expected=f"<= {contract.max_vocab_f32_elems} elements",
+                observed=worst))
+    return RuleReport("memory", observed, findings)
+
+
+# ---- 2. precision -----------------------------------------------------------
+
+#: Contractions/reductions at or above this many reduced elements must
+#: accumulate in the policy's accum dtype; tiny ones (scalar bookkeeping,
+#: metric averages) are noise, not a numerics hazard.
+ACCUM_MIN_REDUCED = 64
+
+_MATMUL_PRIMS = ("dot_general", "conv_general_dilated")
+
+
+def _reduced_elems_dot(eqn) -> int:
+    dims = eqn.params.get("dimension_numbers")
+    if not dims:
+        return 0
+    (lhs_c, _), _ = dims
+    shape = eqn.invars[0].aval.shape
+    n = 1
+    for d in lhs_c:
+        n *= int(shape[d])
+    return n
+
+
+def rule_precision(traced: TracedProgram,
+                   contract: ProgramContract) -> RuleReport:
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_guide_tpu.core import precision
+
+    policy = precision.resolve(contract.policy)
+    compute = jnp.dtype(policy.compute_dtype)
+    accum = jnp.dtype(policy.accum_dtype)
+    observed: dict = {"policy": policy.name, "matmuls": 0,
+                      "bad_operand_matmuls": 0, "bad_accum_ops": 0}
+    findings = []
+    for eqn in walker.walk(traced.jaxpr):
+        name = eqn.primitive.name
+        if name in _MATMUL_PRIMS:
+            observed["matmuls"] += 1
+            op_dtypes = {jnp.dtype(v.aval.dtype) for v in eqn.invars
+                         if jnp.issubdtype(v.aval.dtype, jnp.floating)}
+            bad = op_dtypes - {compute}
+            if bad:
+                observed["bad_operand_matmuls"] += 1
+                findings.append(Finding(
+                    "precision",
+                    f"{name} operands in {sorted(d.name for d in bad)} "
+                    f"violate the {policy.name} policy's compute dtype",
+                    expected=compute.name,
+                    observed=sorted(d.name for d in bad)))
+            out = jnp.dtype(eqn.outvars[0].aval.dtype)
+            if (_reduced_elems_dot(eqn) >= ACCUM_MIN_REDUCED
+                    and jnp.issubdtype(out, jnp.floating)
+                    and out != accum):
+                observed["bad_accum_ops"] += 1
+                findings.append(Finding(
+                    "precision",
+                    f"{name} contracting {_reduced_elems_dot(eqn)} "
+                    f"elements accumulates in {out.name} (set "
+                    "preferred_element_type)",
+                    expected=accum.name, observed=out.name))
+        elif name == "reduce_sum":
+            inv, out = eqn.invars[0].aval, eqn.outvars[0].aval
+            if not jnp.issubdtype(out.dtype, jnp.floating):
+                continue
+            import numpy as np
+
+            reduced = int(np.prod(inv.shape or (1,))) // max(
+                1, int(np.prod(out.shape or (1,))))
+            if (reduced >= ACCUM_MIN_REDUCED
+                    and jnp.dtype(out.dtype) != accum):
+                observed["bad_accum_ops"] += 1
+                findings.append(Finding(
+                    "precision",
+                    f"reduce_sum over {reduced} elements accumulates in "
+                    f"{jnp.dtype(out.dtype).name}",
+                    expected=accum.name,
+                    observed=jnp.dtype(out.dtype).name))
+    return RuleReport("precision", observed, findings)
+
+
+# ---- 3. collectives ---------------------------------------------------------
+
+
+def rule_collectives(traced: TracedProgram,
+                     contract: ProgramContract) -> RuleReport:
+    census = walker.collective_census(traced.jaxpr)
+    observed = {"census": dict(sorted(census.items()))}
+    findings = []
+    if contract.collectives is None:  # census-only program: observe, allow
+        return RuleReport("collectives", observed, findings)
+    for key, want in sorted(contract.collectives.items()):
+        got = census.get(key, 0)
+        lo, hi = want if isinstance(want, tuple) else (want, want)
+        if not lo <= got <= hi:
+            findings.append(Finding(
+                "collectives",
+                f"{key}: expected "
+                + (f"{lo}" if lo == hi else f"{lo}..{hi}")
+                + f", traced {got}",
+                expected=want, observed=got))
+    if contract.strict_collectives:
+        for key in sorted(set(census) - set(contract.collectives)):
+            findings.append(Finding(
+                "collectives",
+                f"undeclared collective {key} x{census[key]} in the trace "
+                "(stray communication)",
+                expected="absent", observed=census[key]))
+    return RuleReport("collectives", observed, findings)
+
+
+# ---- 4. donation ------------------------------------------------------------
+
+
+def rule_donation(traced: TracedProgram,
+                  contract: ProgramContract) -> RuleReport:
+    spec = contract.donation
+    if spec is None:
+        return RuleReport("donation", {"declared": None}, [])
+    jaxpr = traced.jaxpr.jaxpr
+    observed = {"declared": list(spec.argnums), "mode": spec.mode}
+    findings = []
+
+    # flat invar index ranges per argument
+    starts, pos = [], 0
+    for leaves in traced.arg_leaf_avals:
+        starts.append(pos)
+        pos += len(leaves)
+    use_counts = walker.input_use_counts(jaxpr)
+    deep_used = walker.deep_input_used(jaxpr)
+
+    donated: list[tuple[int, Any]] = []  # (flat index, aval)
+    for argnum in spec.argnums:
+        if argnum >= len(traced.arg_leaf_avals):
+            findings.append(Finding(
+                "donation", f"donate argnum {argnum} out of range",
+                expected=f"< {len(traced.arg_leaf_avals)} args",
+                observed=argnum))
+            continue
+        for k, aval in enumerate(traced.arg_leaf_avals[argnum]):
+            donated.append((starts[argnum] + k, aval))
+
+    for idx, aval in donated:
+        if not deep_used[idx]:
+            findings.append(Finding(
+                "donation",
+                f"donated buffer (arg leaf {idx}, "
+                f"{getattr(aval, 'dtype', '?')}{list(aval.shape)}) is never "
+                "read — dead donation",
+                expected="buffer read at least once", observed="unused"))
+        elif use_counts[idx] > 1:
+            findings.append(Finding(
+                "donation",
+                f"donated buffer (arg leaf {idx}) referenced "
+                f"{use_counts[idx]}x at top level — still live after the "
+                "donating call, XLA cannot alias it",
+                expected="exactly one reference", observed=use_counts[idx]))
+
+    if spec.mode == "alias":
+        # XLA input-output alias feasibility: every donated leaf must find
+        # a same-shape/dtype output leaf, each output used at most once.
+        from collections import Counter
+
+        sig = lambda a: (tuple(a.shape), str(a.dtype))  # noqa: E731
+        outs = Counter(sig(v.aval) for v in jaxpr.outvars)
+        unmatched = 0
+        for _, aval in donated:
+            if outs[sig(aval)] > 0:
+                outs[sig(aval)] -= 1
+            else:
+                unmatched += 1
+                findings.append(Finding(
+                    "donation",
+                    f"donated {str(aval.dtype)}{list(aval.shape)} leaf has "
+                    "no matching output to alias — the donation is dropped "
+                    "(XLA warns 'donated buffer not usable')",
+                    expected="a same-shape/dtype output leaf",
+                    observed="no match"))
+        observed["alias_unmatched"] = unmatched
+    observed["donated_leaves"] = len(donated)
+    return RuleReport("donation", observed, findings)
+
+
+# ---- 5. determinism ---------------------------------------------------------
+
+#: Host-callback / side-channel primitives: anything here inside a step
+#: function breaks replay determinism (callbacks observe host state and
+#: order) and stalls the TPU on a host round-trip.
+HOST_CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "infeed", "outfeed", "outside_call", "host_callback_call",
+})
+
+#: Primitives whose lowering is nondeterministic across runs (XLA's
+#: stateful RNG — unlike the threefry/counter path jax.random uses).
+NONDETERMINISTIC_PRIMS = frozenset({"rng_uniform"})
+
+
+def rule_determinism(traced: TracedProgram,
+                     contract: ProgramContract) -> RuleReport:
+    hits: dict[str, int] = {}
+    for eqn in walker.walk(traced.jaxpr):
+        name = eqn.primitive.name
+        if name in HOST_CALLBACK_PRIMS or name in NONDETERMINISTIC_PRIMS:
+            hits[name] = hits.get(name, 0) + 1
+    observed = {"hits": hits}
+    findings = [
+        Finding(
+            "determinism",
+            f"{'host callback' if n in HOST_CALLBACK_PRIMS else 'nondeterministic'}"  # noqa: E501
+            f" primitive {n} x{c} inside the step function",
+            expected="absent", observed=c)
+        for n, c in sorted(hits.items())
+        if n not in contract.allowed_callbacks
+    ]
+    return RuleReport("determinism", observed, findings)
+
+
+#: Registry the linter iterates — order is the report order.
+ALL_RULES: tuple[Callable[[TracedProgram, ProgramContract], RuleReport],
+                 ...] = (
+    rule_memory,
+    rule_precision,
+    rule_collectives,
+    rule_donation,
+    rule_determinism,
+)

@@ -371,4 +371,83 @@ def make_generate_fn(cfg: TransformerConfig, *, max_new_tokens: int,
     # actually aliases the cache argument (False on the CPU backend)
     generate.donates_cache = donate
     generate.last_stats = None
+    # static-analysis hooks (analysis/): the compiled entry itself and the
+    # donation INTENT — what a TPU run donates, even where the cpu gate
+    # turned actual donation off (the lint audits the intent's soundness)
+    generate.jitted = jitted
+    generate.declared_donate_argnums = (2, 3) if spec else (2,)
     return generate
+
+
+# ---- program contracts (analysis/) ------------------------------------------
+
+
+def lint_contracts():
+    """Contracts for the decode entry programs (vanilla + speculative).
+
+    The serve path must be collective-free (it runs single-device or
+    replicated; a stray psum here would deadlock a sharded server),
+    host-callback-free (determinism + no per-token host round-trips),
+    and its declared cache donation must be *scratch*-sound: the program
+    returns only tokens, so the cache can never alias an output — the
+    donation exists to let XLA reuse the buffer in place — and the lint
+    checks the cache is read exactly once at top level instead."""
+    from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        DonationSpec,
+        ProgramContract,
+    )
+
+    def build(spec_layers):
+        def _build():
+            import jax
+
+            from distributed_tensorflow_guide_tpu.analysis.fixtures import (
+                tiny_lm_cfg,
+            )
+
+            cfg = tiny_lm_cfg(max_len=32)
+            gen = make_generate_fn(
+                cfg, max_new_tokens=4, spec_draft_layers=spec_layers,
+                spec_lookahead=2 if spec_layers else 4)
+            B, P = 2, 8
+            prompt = jax.ShapeDtypeStruct((B, P), "int32")
+            model = Transformer(decode_config(cfg))
+            params = jax.eval_shape(
+                lambda p: model.init(jax.random.PRNGKey(0), p, 0),
+                prompt)["params"]
+            cache = _jax_sds_tree(cache_shapes(cfg, B))
+            rng = jax.random.PRNGKey(0)
+            args = [params, prompt, cache, rng]
+            if spec_layers:
+                dcfg = dataclasses.replace(cfg, num_layers=spec_layers)
+                args.insert(3, _jax_sds_tree(cache_shapes(dcfg, B)))
+            return gen.jitted, tuple(args)
+
+        return _build
+
+    def _jax_sds_tree(tree):
+        import jax
+
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), tree)
+
+    common = dict(
+        policy="f32",
+        collectives={},  # strict: the serve path is collective-free
+        sources=("distributed_tensorflow_guide_tpu.models.generation",
+                 "distributed_tensorflow_guide_tpu.models.transformer"),
+    )
+    return [
+        ProgramContract(
+            name="decode_step",
+            build=build(0),
+            donation=DonationSpec(argnums=(2,), mode="scratch"),
+            notes="vanilla scan decode: cache donated as scratch",
+            **common),
+        ProgramContract(
+            name="decode_spec_step",
+            build=build(1),
+            donation=DonationSpec(argnums=(2, 3), mode="scratch"),
+            notes="self-speculative decode (while_loop body audited too)",
+            **common),
+    ]

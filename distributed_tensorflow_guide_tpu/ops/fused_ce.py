@@ -240,3 +240,47 @@ def fused_next_token_loss(x, kernel, tokens, *, chunk: int | None = None,
     return fused_cross_entropy(
         x[:, :-1], kernel, tokens[:, 1:], chunk=chunk, axis=axis,
         reduction=reduction)
+
+
+# ---- program contracts (analysis/) ------------------------------------------
+
+
+def lint_contracts():
+    """Contract for the fused-CE loss+grad program under the bf16 policy —
+    the precision-conformance showcase: every chunk matmul must take bf16
+    operands and accumulate in f32 (``preferred_element_type``), the
+    log-sum-exp running stats must stay f32, and no f32 (N, V) logits
+    tensor may exist in forward OR backward (the ``vocab_rows=N`` floor
+    keeps the legitimate (D, V) weight gradient out of scope)."""
+    from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        ProgramContract,
+    )
+
+    N, D, V, CHUNK = 64, 32, 128, 32
+
+    def _build():
+        import jax
+        import jax.numpy as jnp
+
+        targets = jnp.zeros((N,), jnp.int32)
+
+        def loss(x, kernel):
+            return fused_cross_entropy(x, kernel, targets, chunk=CHUNK)
+
+        fn = jax.value_and_grad(loss, argnums=(0, 1))
+        x = jax.ShapeDtypeStruct((N, D), jnp.bfloat16)
+        kernel = jax.ShapeDtypeStruct((D, V), jnp.bfloat16)
+        return fn, (x, kernel)
+
+    return [
+        ProgramContract(
+            name="fused_ce_loss_grad",
+            build=_build,
+            policy="bf16",
+            vocab_dim=V,
+            vocab_rows=N,
+            max_vocab_f32_elems=0,
+            collectives={},  # single-shard: no vocab-parallel psums
+            sources=("distributed_tensorflow_guide_tpu.ops.fused_ce",),
+            notes="bf16 MXU operands, f32 accumulation, no full logits"),
+    ]

@@ -360,3 +360,70 @@ class DataParallel:
             check_vma=False,
         )
         return jax.jit(sharded)
+
+
+# ---- program contracts (analysis/) ------------------------------------------
+
+
+def lint_contracts():
+    """Contracts for the static-analysis linter: the mono train step
+    (one grad pmean + one pmean per metric) and the bucketed-overlap step,
+    whose collective count is DERIVED from the bucket partition — N
+    buckets must mean exactly N mid-backward grad psums, the structure
+    the latency-hiding scheduler needs."""
+    import numpy as np
+
+    from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        DonationSpec,
+        ProgramContract,
+    )
+    from distributed_tensorflow_guide_tpu.core.mesh import (
+        MeshSpec,
+        build_mesh,
+    )
+    from distributed_tensorflow_guide_tpu.parallel import overlap
+
+    def build(overlap_on):
+        def _build():
+            from distributed_tensorflow_guide_tpu.analysis.fixtures import (
+                tiny_mlp,
+            )
+
+            loss_fn, state, batch = tiny_mlp()
+            mesh = build_mesh(MeshSpec(data=-1))
+            dp = DataParallel(mesh, overlap=overlap_on,
+                              bucket_bytes=1 if overlap_on else None)
+            step = dp.make_train_step(loss_fn, donate=True)
+            return step, (state, batch)
+
+        return _build
+
+    sources = ("distributed_tensorflow_guide_tpu.parallel.data_parallel",
+               "distributed_tensorflow_guide_tpu.parallel.overlap",
+               "distributed_tensorflow_guide_tpu.collectives.collectives")
+    # the tiny_mlp param tree at bucket_bytes=1: one bucket per leaf
+    leaf_shapes = [(16, 32), (32,), (32, 16), (16,)]
+    n_buckets = len(overlap.bucket_assignment(
+        [np.zeros(s, np.float32) for s in leaf_shapes], bucket_bytes=1))
+    return [
+        ProgramContract(
+            name="dp_train_step",
+            build=build(False),
+            policy="f32",
+            # 1 grad-tree pmean + the loss and mae metric pmeans
+            collectives={"psum[data]": 3},
+            donation=DonationSpec(argnums=(0,)),
+            sources=sources,
+            notes="sync-DP mono step: one gradient collective per step"),
+        ProgramContract(
+            name="dp_overlap_train_step",
+            build=build(True),
+            policy="f32",
+            # one psum per gradient bucket (emitted mid-backward) + the
+            # 2 metric pmeans — the bucket partition IS the expectation
+            collectives={"psum[data]": n_buckets + 2},
+            donation=DonationSpec(argnums=(0,)),
+            sources=sources,
+            notes=f"bucketed backward: {n_buckets} buckets -> "
+                  f"{n_buckets} grad psums"),
+    ]

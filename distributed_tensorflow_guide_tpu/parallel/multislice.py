@@ -345,3 +345,71 @@ class MultiSliceLocalSGD:
             check_vma=False,
         )
         return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+# ---- program contracts (analysis/) ------------------------------------------
+
+
+def lint_contracts():
+    """Contracts for one outer round at both outer-sync settings. The
+    load-bearing expectation is the ``outer="off"`` program: ZERO
+    collectives on the dcn axis — including the metric scalar — because
+    the bench that measures exposed DCN cost uses it as the no-DCN timing
+    control; one stray latency-bound round-trip per round would poison
+    the measurement. ``outer="on"`` pins the full DCN budget: one delta
+    pmean per float param leaf + per float optimizer leaf, and the metric
+    pmean over (dcn, data)."""
+    from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        DonationSpec,
+        ProgramContract,
+    )
+
+    def build(outer):
+        def _build():
+            from distributed_tensorflow_guide_tpu.analysis.fixtures import (
+                tiny_mlp,
+            )
+            from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec
+
+            loss_fn, state, batch = tiny_mlp()
+            mesh = two_tier_mesh(MeshSpec(data=-1), n_slices=2)
+            ms = MultiSliceLocalSGD(mesh, sync_period=2, outer=outer)
+            tt = ms.init(state)
+            step = ms.make_train_step(loss_fn, donate=True)
+            batches = jax.tree.map(
+                lambda x: jnp.stack([x, x]), batch)  # (sync_period, B, ...)
+            return step, (tt, batches)
+
+        return _build
+
+    sources = ("distributed_tensorflow_guide_tpu.parallel.multislice",
+               "distributed_tensorflow_guide_tpu.collectives.collectives")
+    # tiny_mlp: 4 float param leaves (delta pmean) + 4 float momentum
+    # leaves in the SGD trace state (opt-state pmean)
+    n_dcn = 4 + 4
+    return [
+        ProgramContract(
+            name="multislice_outer_on_round",
+            build=build("on"),
+            policy="f32",
+            collectives={
+                "psum[data]": 1,       # the inner grad pmean (scan body)
+                "psum[dcn]": n_dcn,    # delta + float-opt-state sync
+                "psum[dcn,data]": 1,   # the metric pmean over both tiers
+            },
+            donation=DonationSpec(argnums=(0,)),
+            sources=sources,
+            notes="two-tier round: dense ICI inner steps, one DCN sync"),
+        ProgramContract(
+            name="multislice_outer_off_round",
+            build=build("off"),
+            policy="f32",
+            # strict census: the inner grad pmean + the within-slice
+            # metric pmean and NOTHING else — any dcn-axis collective
+            # showing up here fails the lint
+            collectives={"psum[data]": 2},
+            donation=DonationSpec(argnums=(0,)),
+            sources=sources,
+            notes="outer=off is DCN-free by contract (bench timing "
+                  "control)"),
+    ]

@@ -27,7 +27,6 @@ loss, so the others' grads are structurally zero and one ``psum`` over
 from __future__ import annotations
 
 import functools
-from typing import Any
 
 import flax.linen as nn
 import jax
@@ -1578,3 +1577,70 @@ class PipelinedLM:
         )
         with self.mesh:
             return jax.jit(tx.init, out_shardings=shardings)(params)
+
+
+# ---- program contracts (analysis/) ------------------------------------------
+
+
+def lint_contracts():
+    """Contract for the GPipe train step with the fused-CE head: the
+    no-full-logits memory pin (no f32 (mb*(S-1), V) intermediate anywhere
+    in the schedule) plus the stage-boundary collective census — the
+    counts are pinned at the 8-device (data=4, pipe=2, M=2) fixture."""
+    from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        DonationSpec,
+        ProgramContract,
+    )
+
+    def _build():
+        import jax
+        import optax
+
+        from distributed_tensorflow_guide_tpu.analysis.fixtures import (
+            tiny_lm_cfg,
+        )
+        from distributed_tensorflow_guide_tpu.core.mesh import (
+            MeshSpec,
+            build_mesh,
+        )
+
+        # max_len=32 so one microbatch spans 31 target rows — ABOVE the
+        # 16-row CE chunk; the vocab_rows floor can then admit the chunk
+        # logits while still catching a full-logits regression
+        cfg = tiny_lm_cfg(vocab_size=80, max_len=32)
+        mesh = build_mesh(MeshSpec(data=4, pipe=2))
+        pp = PipelinedLM(mesh, cfg, num_microbatches=2, fused_ce=True,
+                         ce_chunk=16)
+        params = jax.eval_shape(pp.init_host_params, jax.random.PRNGKey(0))
+        tx = optax.sgd(0.1)
+        opt_state = jax.eval_shape(tx.init, params)
+        step = pp.make_train_step(tx, params, donate=True)
+        tokens = jax.ShapeDtypeStruct((8, 32), "int32")
+        return step, (opt_state, params, tokens)
+
+    return [
+        ProgramContract(
+            name="pipeline_fused_ce_train_step",
+            build=_build,
+            policy="f32",
+            vocab_dim=80,
+            vocab_rows=17,  # > ce_chunk(16), <= microbatch rows (31)
+            max_vocab_f32_elems=0,
+            collectives={
+                # one activation handoff + its backward transpose (M=2,
+                # P=2 — the schedule fuses per-tick sends into one pair)
+                "ppermute[pipe]": 2,
+                # loss + embed-grad + head-grad reductions over pipe
+                "psum[pipe]": 3,
+                # grad-tree pmean + loss pmean over data
+                "psum[data]": 2,
+            },
+            donation=DonationSpec(argnums=(0, 1)),
+            sources=(
+                "distributed_tensorflow_guide_tpu.parallel.pipeline",
+                "distributed_tensorflow_guide_tpu.ops.fused_ce",
+                "distributed_tensorflow_guide_tpu.collectives.collectives",
+            ),
+            notes="GPipe schedule + fused-CE head: no full logits, "
+                  "bounded stage-boundary traffic"),
+    ]

@@ -1,63 +1,17 @@
-"""Shared jaxpr/trace-scanning pin helpers.
+"""Thin shims over the analysis walker (kept for import stability).
 
-Consolidates the duplicated scanners from tests/test_fused_ce.py and
-tests/test_autotune.py (round 9) so every structural pin — the fused-CE
-no-full-logits walk, the overlap layer's byte-identical-trace pin — uses
-one implementation.
+Round 9 consolidated the duplicated per-test jaxpr scanners here; round 13
+promoted them into the library proper as
+``distributed_tensorflow_guide_tpu.analysis.walker`` — the sub-jaxpr-
+complete traversal the contract linter is built on (which also fixes this
+module's old blind spots: dict-valued eqn params and ``eqn.invars``
+aliasing; see tests/test_analysis.py for the positive controls). Tests
+import from the package directly now; these re-exports stay so any
+out-of-tree user of the old names keeps working.
 """
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.extend import core as jex_core
-
-
-def max_f32_elems_with_vocab_dim(jaxpr, n, v):
-    """Largest f32 intermediate of shape (..., V) with >= n rows, walked
-    through every sub-jaxpr (scan/pjit/custom_vjp bodies included)."""
-    if isinstance(jaxpr, jex_core.ClosedJaxpr):
-        jaxpr = jaxpr.jaxpr
-    worst = 0
-    for eqn in jaxpr.eqns:
-        for var in eqn.outvars:
-            aval = var.aval
-            shape = getattr(aval, "shape", ())
-            if (getattr(aval, "dtype", None) == jnp.float32
-                    and len(shape) >= 2 and shape[-1] == v
-                    and int(np.prod(shape[:-1])) >= n):
-                worst = max(worst, int(np.prod(shape)))
-        for p in eqn.params.values():
-            for sub in (p if isinstance(p, (tuple, list)) else (p,)):
-                if isinstance(sub, (jex_core.Jaxpr, jex_core.ClosedJaxpr)):
-                    worst = max(
-                        worst, max_f32_elems_with_vocab_dim(sub, n, v))
-    return worst
-
-
-def count_primitives(jaxpr, name: str) -> int:
-    """Occurrences of one primitive across the jaxpr and every sub-jaxpr
-    — e.g. how many ``psum`` binds a bucketed backward emits."""
-    if isinstance(jaxpr, jex_core.ClosedJaxpr):
-        jaxpr = jaxpr.jaxpr
-    n = 0
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == name:
-            n += 1
-        for p in eqn.params.values():
-            for sub in (p if isinstance(p, (tuple, list)) else (p,)):
-                if isinstance(sub, (jex_core.Jaxpr, jex_core.ClosedJaxpr)):
-                    n += count_primitives(sub, name)
-    return n
-
-
-def traced_text(fn, *args) -> str:
-    """The full textual trace of ``fn`` at ``args`` (every sub-jaxpr
-    printed) — the byte-identity instrument: two code paths that must
-    trace the same program compare equal here. Variable naming is
-    deterministic within a process, so equal programs compare equal and
-    any structural drift shows as a diff. Raw object addresses (repr'd
-    closures/meshes in eqn params) are normalized away — they differ per
-    Python instance, not per program."""
-    import re
-
-    return re.sub(r"0x[0-9a-f]+", "0x•", str(jax.make_jaxpr(fn)(*args)))
+from distributed_tensorflow_guide_tpu.analysis.walker import (  # noqa: F401
+    count_primitives,
+    max_f32_elems_with_vocab_dim,
+    traced_text,
+)

@@ -253,3 +253,22 @@ def test_kernel_runners_execute_and_agree_with_reference():
     for kern in ("flash_dq", "flash_dkv", "carry_step"):
         rfn = autotune.make_kernel_runner(kern, (64, 128), **kw)
         jax.block_until_ready(rfn())
+
+
+# ---- structural pin via the analysis walker (round 13) ----------------------
+
+
+def test_cpu_flash_trace_structure_via_walker():
+    """The analysis walker's census over the CPU flash trace: the dense
+    interpret-path fallback must contain matmuls but NO pallas_call and NO
+    collectives — the same hermeticity the autotune CPU contract promises,
+    pinned structurally rather than by string-matching trace text."""
+    from distributed_tensorflow_guide_tpu.analysis import walker
+
+    q, k, v = _qkv(s=64, d=64)
+    jaxpr = jax.make_jaxpr(
+        lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    census = walker.primitive_census(jaxpr)
+    assert census["dot_general"] >= 2  # qk^T and pv
+    assert census["pallas_call"] == 0
+    assert not walker.collective_census(jaxpr)

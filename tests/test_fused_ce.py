@@ -23,7 +23,7 @@ from distributed_tensorflow_guide_tpu.core.compat import shard_map
 from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
 from distributed_tensorflow_guide_tpu.ops import autotune
 from distributed_tensorflow_guide_tpu.ops import fused_ce as fce
-from tests.pin_utils import (
+from distributed_tensorflow_guide_tpu.analysis.walker import (
     max_f32_elems_with_vocab_dim as _max_f32_elems_with_vocab_dim,
 )
 
@@ -145,7 +145,7 @@ def test_fused_rejects_bad_args():
         fce.fused_cross_entropy(x, kernel.T, targets, chunk=8)
 
 
-# ---- the no-full-logits pin (walker shared via tests/pin_utils.py) ----------
+# ---- the no-full-logits pin (analysis.walker, ex tests/pin_utils.py) --------
 
 
 def test_fused_bwd_never_materializes_full_logits():

@@ -414,7 +414,7 @@ def test_default_decode_trace_hermetic_on_cpu(params):
     config (decode_impl='auto', kv_dtype=None) traces byte-identically to
     the explicitly-pinned dense/unquantized config — no Pallas call, no
     quantization, no layout change can leak into CI programs by default."""
-    from tests.pin_utils import traced_text
+    from distributed_tensorflow_guide_tpu.analysis.walker import traced_text
 
     tok = jnp.zeros((2, 1), jnp.int32)
 

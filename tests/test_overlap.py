@@ -37,8 +37,8 @@ from distributed_tensorflow_guide_tpu.parallel import overlap
 from distributed_tensorflow_guide_tpu.parallel.data_parallel import (
     DataParallel,
 )
+from distributed_tensorflow_guide_tpu.analysis.walker import traced_text
 from distributed_tensorflow_guide_tpu.parallel.fsdp import FSDP
-from tests.pin_utils import traced_text
 
 
 @pytest.fixture(autouse=True)
