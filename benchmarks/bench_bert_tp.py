@@ -32,6 +32,10 @@ def main() -> None:
     ap.add_argument("--attn", choices=["auto", "dense", "flash"],
                     default="auto",
                     help="flash composes with TP via custom_partitioning")
+    ap.add_argument("--small", action="store_true",
+                    help="toy width instead of BERT-base 768d (CPU smoke "
+                         "geometry; the TP sharding contract is "
+                         "width-independent)")
     ap.add_argument("--megatron-sp", action="store_true",
                     help="MEGATRON_SP_RULES: sequence-shard the residual "
                          "stream over the model axis (gather/scatter at "
@@ -65,6 +69,9 @@ def main() -> None:
     cfg = dataclasses.replace(
         bert_base(num_classes=2, dtype=jnp.bfloat16),
         num_layers=args.layers, max_len=args.seq_len, attn_impl=args.attn)
+    if args.small:
+        cfg = dataclasses.replace(
+            cfg, vocab_size=1024, num_heads=4, d_model=128, d_ff=512)
     model = Transformer(cfg)
     tp = (TensorParallel(mesh, rules=MEGATRON_SP_RULES)
           if args.megatron_sp else TensorParallel(mesh))

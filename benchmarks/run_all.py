@@ -30,6 +30,7 @@ BENCHES = [
     "bench_comm_overlap.py",  # ICI overlap: exposed-comm fraction A/B
     "bench_resilience.py",    # checkpoint overhead + MTTR/goodput (CPU-real)
     "bench_dcn_hybrid.py",    # two-tier DCN sync tradeoff + elastic resize
+    "bench_serving.py",       # serving under load: continuous vs static
     "bench_lint.py",          # contract linter: full program-registry audit
 ]
 
@@ -41,7 +42,8 @@ SMOKE = {
         ["--fake-devices", "8", "--global-batch", "64", "--steps", "3"],
     "bench_bert_tp.py":
         ["--fake-devices", "8", "--model-parallel", "4", "--layers", "2",
-         "--global-batch", "8", "--seq-len", "64", "--steps", "2"],
+         "--small", "--global-batch", "8", "--seq-len", "64",
+         "--steps", "2"],
     "bench_wide_deep.py":
         ["--fake-devices", "8", "--global-batch", "64", "--steps", "3"],
     "bench_gpt2_pp.py":
@@ -123,6 +125,12 @@ SMOKE = {
         # eat the tier-1 wall-clock budget for coverage tier-1 already
         # has)
         ["--fake-devices", "8", "--small", "--seed", "0"],
+    "bench_serving.py":
+        # platform-independent like bench_resilience: the virtual clock
+        # charges real measured launch times and skips idle, so the
+        # goodput/TTFT/TPOT numbers and the continuous-vs-static A/B are
+        # real on CPU (rates and SLOs self-calibrate to the machine)
+        ["--fake-devices", "1", "--small", "--requests", "6"],
     "bench_lint.py":
         # NOT a liveness stub either: lint is trace-time only, so the
         # smoke run IS the full registry audit at the pinned 8-device

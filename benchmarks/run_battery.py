@@ -142,6 +142,33 @@ BATTERY: list[tuple[str, list[str], int]] = [
     ("gpt2_decode_spec",
      ["benchmarks/bench_generate.py", "--kv-dtype", "model",
       "--decode-impl", "dense", "--spec-draft-layers", "4"], 1800),
+    # serving-under-load rows (PR 10): the continuity row is STATIC
+    # batching with every lever pinned off; each row below flips exactly
+    # one knob against its neighbour (static->continuous batching,
+    # whole-prompt->chunked prefill, model->int8 cache, dense->pallas
+    # reads). bench_serving measures both disciplines every run, so the
+    # continuity row's JSON also carries the continuous side for
+    # cross-checking the A/B.
+    ("serve_continuity",
+     ["benchmarks/bench_serving.py", "--mode", "static",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense"], 1800),
+    ("serve_paged",
+     ["benchmarks/bench_serving.py", "--mode", "continuous",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense"], 1800),
+    ("serve_chunked_prefill",
+     ["benchmarks/bench_serving.py", "--mode", "continuous",
+      "--prefill-chunk", "8", "--kv-dtype", "model",
+      "--decode-impl", "dense"], 1800),
+    ("serve_kv_int8",
+     ["benchmarks/bench_serving.py", "--mode", "continuous",
+      "--prefill-chunk", "32", "--kv-dtype", "int8",
+      "--decode-impl", "dense"], 1800),
+    ("serve_pallas",
+     ["benchmarks/bench_serving.py", "--mode", "continuous",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "pallas"], 1800),
     ("ring_attention_1024",
      ["benchmarks/bench_ring_attention.py", "--seq-len", "1024"], 1500),
     ("ring_attention_2048",

@@ -42,6 +42,9 @@ FIRST_WINDOW = [
     "gpt2_decode_kv_int8",     # one-variable lever rows (round 11)
     "gpt2_decode_pallas",
     "gpt2_decode_spec",
+    "serve_continuity",        # serving A/B (PR 10): static baseline,
+    "serve_paged",             # continuous batching + paged KV,
+    "serve_chunked_prefill",   # + chunked prefill interleave
     "gpt2_pp_fused_ce",
     "gpt2_pp_gpipe",
     "gpt2_flash_seq1024",

@@ -106,6 +106,30 @@ def decode_cache_bytes_per_step(cfg: TransformerConfig, batch_size: int, *,
     return float(read + write)
 
 
+def paged_decode_cache_bytes_per_step(cfg: TransformerConfig, *,
+                                      block_size: int, live_blocks: int,
+                                      active_slots: int) -> float:
+    """KV-cache HBM traffic of ONE paged decode step: the pool's LIVE
+    blocks read (continuous batching reads what resident requests have
+    written, not ``batch * max_len``) and one slot written per active
+    decode slot. Built on the same per-(slot, head)
+    ``ops.decode_attention.cache_slot_bytes`` definition as the dense
+    model above — the serve engine and ``bench_generate.py`` share one
+    byte model, so the serving roofline rows cannot silently reuse the
+    dense ``max_len`` charge (the whole point of paging)."""
+    import jax.numpy as _jnp
+
+    from distributed_tensorflow_guide_tpu.ops.decode_attention import (
+        cache_slot_bytes,
+    )
+
+    kv_dtype = _jnp.int8 if cfg.kv_dtype == "int8" else cfg.dtype
+    per_slot = cfg.num_heads * cache_slot_bytes(cfg.head_dim, kv_dtype)
+    read = cfg.num_layers * live_blocks * block_size * per_slot
+    write = cfg.num_layers * active_slots * per_slot
+    return float(read + write)
+
+
 def decode_hbm_bytes_per_step(cfg: TransformerConfig, params,
                               batch_size: int, *,
                               effective_len: int | None = None) -> float:
@@ -159,6 +183,19 @@ def _sample(logits, key, temperature: float, top_k: int | None):
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+def sample_rows(logits, keys, temperature: float, top_k: int | None):
+    """Per-row sampling: (B, V) logits + (B,) per-row position-derived
+    keys -> (B,) int32 tokens, row b bitwise what a B=1 :func:`_sample`
+    call would emit. This is the serve engine's sampler: continuous
+    batching puts every slot at its own position with its own request
+    rng, and ``vmap`` over the B=1 call is what makes each slot's stream
+    identical to that request's one-shot ``make_generate_fn`` run — the
+    engine-parity acceptance pin."""
+    return jax.vmap(
+        lambda row, key: _sample(row[None], key, temperature, top_k)[0]
+    )(logits, keys)
 
 
 def make_generate_fn(cfg: TransformerConfig, *, max_new_tokens: int,

@@ -106,6 +106,15 @@ class TransformerConfig:
     # "auto"   — pallas on TPU, dense elsewhere (the flash/ring TPU-only
     #            convention; CPU tier-1 traces stay byte-identical).
     decode_impl: str = "auto"
+    # Paged KV cache (serve/paged_cache.py, decode mode only): both set →
+    # the cache collection holds a POOL of ``paged_num_blocks`` blocks of
+    # ``paged_block_size`` slots shared across requests instead of a
+    # per-request (B, max_len, ...) buffer, and every decode call takes a
+    # (B, blocks_per_seq) ``block_tables`` operand plus a per-request
+    # (B,) write ``index`` vector. The serve engine is the only caller;
+    # the one-shot path (both None) is untouched.
+    paged_num_blocks: int | None = None
+    paged_block_size: int | None = None
 
     def __post_init__(self):
         if self.attn_impl not in ("auto", "dense", "flash"):
@@ -127,6 +136,26 @@ class TransformerConfig:
                 "remat_mode must be None, 'none', 'attention' or 'block', "
                 f"got {self.remat_mode!r}"
             )
+        if (self.paged_num_blocks is None) != (self.paged_block_size is None):
+            raise ValueError(
+                "paged_num_blocks and paged_block_size must be set together"
+            )
+        if self.paged_block_size is not None:
+            bad = (self.paged_block_size < 1
+                   or self.max_len % self.paged_block_size)
+            if bad:
+                raise ValueError(
+                    f"paged_block_size {self.paged_block_size} must divide "
+                    f"max_len {self.max_len}"
+                )
+            if self.paged_num_blocks < 2:
+                raise ValueError(
+                    "paged_num_blocks must be >= 2 (one is the trash block)"
+                )
+
+    @property
+    def paged(self) -> bool:
+        return self.paged_num_blocks is not None
 
     @property
     def resolved_remat_mode(self) -> str:
@@ -220,7 +249,8 @@ class MultiHeadAttention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, index=None) -> jax.Array:  # (B, S, D)
+    def __call__(self, x: jax.Array, index=None, *,
+                 block_tables=None) -> jax.Array:  # (B, S, D)
         cfg = self.cfg
         h, hd = cfg.num_heads, cfg.head_dim
         if cfg.tp_axis:  # Megatron f: identity fwd, psum bwd (see tp_axis doc)
@@ -244,7 +274,9 @@ class MultiHeadAttention(nn.Module):
         k = _constrain(k, ("batch", "seq_inner", "heads", "kv"))
         v = _constrain(v, ("batch", "seq_inner", "heads", "kv"))
 
-        if cfg.decode:
+        if cfg.decode and cfg.paged:
+            out = self._paged_decode_attend(q, k, v, index, block_tables)
+        elif cfg.decode:
             out = self._decode_attend(q, k, v, index)
         elif cfg.resolve_attn_impl(x.shape[1]) == "flash":
             from distributed_tensorflow_guide_tpu.ops.flash_attention import (
@@ -406,6 +438,144 @@ class MultiHeadAttention(nn.Module):
         return jnp.einsum("bhqk,bhkd->bqhd", probs,
                           cv.value.astype(cfg.dtype))
 
+    def _paged_decode_attend(self, q, k, v, index, block_tables):
+        """Paged-pool variant of :meth:`_decode_attend` — same math,
+        different cache residency.
+
+        The cache collection holds a POOL of ``cfg.paged_num_blocks``
+        fixed-size blocks shared across requests (serve/paged_cache.py);
+        ``block_tables`` (B, blocks_per_seq) maps each request's logical
+        positions to physical blocks and ``index`` is a PER-REQUEST (B,)
+        write-position vector (continuous batching: every slot sits at
+        its own length). Writes scatter the chunk through the table;
+        reads either stream the pool directly through the Pallas
+        block-table kernel (``decode_impl="pallas"``) or gather the
+        logical views and run the exact dense math of the non-paged
+        branches — the per-row mask zeroes whatever junk the trash block
+        and unwritten slots carry, which is what keeps the fallback
+        token-identical to the one-shot path on CPU.
+        """
+        cfg = self.cfg
+        if index is None or block_tables is None:
+            raise ValueError(
+                "paged decode requires the per-request index vector and "
+                "the block tables")
+        from distributed_tensorflow_guide_tpu.serve.paged_cache import (
+            gather_view,
+            scatter_chunk,
+        )
+
+        B, C, h, hd = q.shape
+        N, bs = cfg.paged_num_blocks, cfg.paged_block_size
+        idx = jnp.asarray(index)
+        if idx.ndim == 0:
+            idx = jnp.broadcast_to(idx, (B,))
+        quantized = cfg.kv_dtype == "int8"
+        impl = cfg.resolve_decode_impl()
+        if not quantized and impl == "dense":
+            # legacy-layout pool: gather -> the historical dense math
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (N, bs, h, hd), cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (N, bs, h, hd), cfg.dtype)
+            ck.value = scatter_chunk(ck.value, k, block_tables, idx,
+                                     block_size=bs, seq_axis=1)
+            cv.value = scatter_chunk(cv.value, v, block_tables, idx,
+                                     block_size=bs, seq_axis=1)
+            keys = gather_view(ck.value, block_tables, seq_axis=1)
+            vals = gather_view(cv.value, block_tables, seq_axis=1)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, keys) / jnp.sqrt(
+                hd).astype(cfg.dtype)
+            q_pos = idx[:, None] + jnp.arange(C)  # (B, C)
+            k_pos = jnp.arange(cfg.max_len)
+            mask = k_pos[None, None, :] <= q_pos[:, :, None]  # (B, C, S)
+            scores = jnp.where(mask[:, None], scores,
+                               jnp.finfo(cfg.dtype).min)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(
+                cfg.dtype)
+            return jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
+
+        from distributed_tensorflow_guide_tpu.ops import (
+            decode_attention as DA,
+        )
+
+        cache_dtype = jnp.int8 if quantized else cfg.dtype
+        ck = self.variable("cache", "cached_key", jnp.zeros,
+                           (N, h, bs, hd), cache_dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros,
+                           (N, h, bs, hd), cache_dtype)
+        kT = jnp.transpose(k, (0, 2, 1, 3))  # (B, H, C, hd)
+        vT = jnp.transpose(v, (0, 2, 1, 3))
+        ks = vs = None
+        if quantized:
+            ks = self.variable("cache", "key_scale", jnp.zeros,
+                               (N, h, 1, bs), jnp.float32)
+            vs = self.variable("cache", "value_scale", jnp.zeros,
+                               (N, h, 1, bs), jnp.float32)
+            k8, k_sc = DA.quantize_kv(kT)
+            v8, v_sc = DA.quantize_kv(vT)
+            ck.value = scatter_chunk(ck.value, k8, block_tables, idx,
+                                     block_size=bs, seq_axis=2)
+            cv.value = scatter_chunk(cv.value, v8, block_tables, idx,
+                                     block_size=bs, seq_axis=2)
+            ks.value = scatter_chunk(ks.value, k_sc[:, :, None, :],
+                                     block_tables, idx,
+                                     block_size=bs, seq_axis=3)
+            vs.value = scatter_chunk(vs.value, v_sc[:, :, None, :],
+                                     block_tables, idx,
+                                     block_size=bs, seq_axis=3)
+        else:
+            ck.value = scatter_chunk(ck.value, kT, block_tables, idx,
+                                     block_size=bs, seq_axis=2)
+            cv.value = scatter_chunk(cv.value, vT, block_tables, idx,
+                                     block_size=bs, seq_axis=2)
+
+        lengths = idx + C  # (B,) live length after the write
+        if impl == "pallas":
+            blk_k = DA.paged_decode_blk_k_for(
+                b=B, h=h, s=cfg.max_len, d=hd, dtype=cache_dtype,
+                block_size=bs)
+            if DA.paged_supported(cfg.max_len, bs, blk_k, C):
+                return DA.paged_decode_attention(
+                    q, ck.value, cv.value, block_tables, lengths,
+                    key_scale_pool=ks.value if quantized else None,
+                    value_scale_pool=vs.value if quantized else None,
+                    block_size=bs, blk_k=blk_k)
+            if C <= DA.DECODE_MAX_CHUNK:
+                from distributed_tensorflow_guide_tpu.ops.flash_attention import (  # noqa: E501
+                    _note_fallback,
+                )
+
+                _note_fallback(
+                    cfg.max_len, hd, C, blk_k,
+                    origin="paged_decode_attention",
+                    msg=f"paged_decode_attention: block_size {bs} has no "
+                        f"usable KV edge (resolved {blk_k}); falling back "
+                        "to the gathered dense path (slower)")
+
+        # dense gather fallback on the kernel layout: identical math to
+        # the non-paged kernel-layout branch, per-request mask rows
+        keys = gather_view(ck.value, block_tables, seq_axis=2)
+        vals = gather_view(cv.value, block_tables, seq_axis=2)
+        scores = jnp.einsum("bqhd,bhkd->bhqk", q,
+                            keys.astype(cfg.dtype)) / jnp.sqrt(
+            hd).astype(cfg.dtype)
+        if quantized:
+            k_scale = gather_view(ks.value, block_tables, seq_axis=3)
+            v_scale = gather_view(vs.value, block_tables, seq_axis=3)
+            scores = scores.astype(jnp.float32) * k_scale  # (B, H, 1, S)
+        q_pos = idx[:, None] + jnp.arange(C)  # (B, C)
+        k_pos = jnp.arange(cfg.max_len)
+        mask = k_pos[None, None, :] <= q_pos[:, :, None]  # (B, C, S)
+        scores = jnp.where(mask[:, None], scores,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
+        if quantized:
+            probs = probs * v_scale
+        probs = probs.astype(cfg.dtype)
+        return jnp.einsum("bhqk,bhkd->bqhd", probs,
+                          vals.astype(cfg.dtype))
+
 
 class MLP(nn.Module):
     cfg: TransformerConfig
@@ -444,7 +614,8 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, index=None) -> jax.Array:
+    def __call__(self, x: jax.Array, index=None, *,
+                 block_tables=None) -> jax.Array:
         cfg = self.cfg
         # Attention-only selective remat (core/precision.py): checkpoint the
         # attention sub-layer here so EVERY consumer — the flat Transformer,
@@ -455,9 +626,12 @@ class Block(nn.Module):
         attn_cls = MultiHeadAttention
         if cfg.resolved_remat_mode == "attention":
             attn_cls = nn.remat(MultiHeadAttention, prevent_cse=False)
-        x = x + attn_cls(cfg, name="attn")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x), index
-        )
+        attn = attn_cls(cfg, name="attn")
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+        if block_tables is None:  # the historical call, kept verbatim
+            x = x + attn(h, index)
+        else:
+            x = x + attn(h, index, block_tables=block_tables)
         x = x + MLP(cfg, name="mlp")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
         )
@@ -472,6 +646,7 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array, index=None, *,
+                 block_tables=None,
                  return_hidden: bool = False) -> jax.Array:
         # tokens (B, S) int32; ``index`` only in cfg.decode mode: the
         # absolute position of tokens[:, 0] (prefill passes 0, the decode
@@ -493,7 +668,13 @@ class Transformer(nn.Module):
         )(tokens)
         positions = jnp.arange(tokens.shape[1])[None, :]
         if cfg.decode:
-            positions = positions + index
+            # the serve engine passes a PER-REQUEST (B,) index vector
+            # (continuous batching: each slot sits at its own length);
+            # the scalar one-shot line stays verbatim (hermeticity pin)
+            if getattr(index, "ndim", 0):
+                positions = positions + index[:, None]
+            else:
+                positions = positions + index
         pos = nn.Embed(
             cfg.max_len,
             cfg.d_model,
@@ -508,7 +689,11 @@ class Transformer(nn.Module):
         if cfg.resolved_remat_mode == "block":
             block = nn.remat(Block, prevent_cse=False)
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"block_{i}")(x, index)
+            if block_tables is None:  # the historical call, kept verbatim
+                x = block(cfg, name=f"block_{i}")(x, index)
+            else:
+                x = block(cfg, name=f"block_{i}")(
+                    x, index, block_tables=block_tables)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         if return_hidden:
             return x

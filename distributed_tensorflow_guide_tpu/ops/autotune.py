@@ -36,7 +36,7 @@ from pathlib import Path
 from typing import Callable, NamedTuple
 
 KERNELS = ("flash_fwd", "flash_dq", "flash_dkv", "carry_step",
-           "decode_attend")
+           "decode_attend", "decode_paged")
 
 # The tested fallback every call site gets on a table miss — the historical
 # hardcode, now the one definition it reduces to.
@@ -51,6 +51,13 @@ DEFAULT_BLOCKS: tuple[int, int] = (128, 128)
 # bf16 ones — the bandwidth/VMEM balance differs), causal=False (the
 # length masking is runtime state, not a block-liveness regime).
 DECODE_KERNEL = "decode_attend"
+# The paged variant (serve/paged_cache.py pools): same grid, same tuning
+# axis, but the KV edge must additionally DIVIDE the pool block size —
+# a kernel tile never straddles two physical blocks, so the block-table
+# index map stays a pure block-id lookup. Distinct table key: the tuned
+# edge for a contiguous (B, H, S, hd) cache need not be the winner when
+# every tile rides through an indirection.
+PAGED_DECODE_KERNEL = "decode_paged"
 DECODE_CHUNK_SUBLANES = 8  # single-token q chunks are padded to one sublane
 
 # Largest q chunk the kernel accepts: the q tile is NOT blocked (one grid
@@ -549,7 +556,7 @@ def live_block_count(s: int, blk_q: int, blk_k: int, causal: bool) -> int:
 # dq adds ds.k; dkv does qk^T + p^T.do + do.v^T + ds^T.q. The decode kernel
 # is the forward pair again (qk^T + p.v) over a sublane-padded 1-token chunk.
 _MXU_PASSES = {"flash_fwd": 2, "carry_step": 2, "flash_dq": 3,
-               "flash_dkv": 4, "decode_attend": 2}
+               "flash_dkv": 4, "decode_attend": 2, "decode_paged": 2}
 
 
 def kernel_flops(kernel: str, *, b: int, h: int, s: int, d: int,
@@ -563,7 +570,7 @@ def kernel_flops(kernel: str, *, b: int, h: int, s: int, d: int,
     throughput ~s/blk_q-fold."""
     bq, bk = blocks
     dp = padded_head_dim(d)
-    if kernel == DECODE_KERNEL:
+    if kernel in (DECODE_KERNEL, PAGED_DECODE_KERNEL):
         live = s // bk
     else:
         live = live_block_count(s, bq, bk, causal)
@@ -622,7 +629,7 @@ def kernel_vmem_bytes(kernel: str, blk_q: int, blk_k: int, dp: int,
         tiles = (2 * q_t + 4 * k_t) * io + 2 * l_t * 4
         scratch = 2 * k_t * 4
         body = 4 * score
-    elif kernel == "decode_attend":
+    elif kernel in ("decode_attend", "decode_paged"):
         # q tile + K/V cache tiles (at the CACHE dtype — int8 is what makes
         # the big edges affordable) + the two (1, blk_k) f32 scale rows;
         # scratch = (m, l) lane-broadcast stats + the f32 accumulator;
@@ -646,7 +653,7 @@ def candidate_blocks(kernel: str, *, s: int, d: int,
     edge (its Q edge is the fixed sublane-padded token chunk)."""
     dp = padded_head_dim(d)
     edges = [e for e in CANDIDATE_EDGES if e <= s and s % e == 0]
-    if kernel == DECODE_KERNEL:
+    if kernel in (DECODE_KERNEL, PAGED_DECODE_KERNEL):
         bq = DECODE_CHUNK_SUBLANES
         return [
             (bq, bk) for bk in edges

@@ -52,6 +52,7 @@ from distributed_tensorflow_guide_tpu.ops.autotune import (
     DECODE_KERNEL,
     DECODE_MAX_CHUNK,
     DEFAULT_DECODE_BLK_K,
+    PAGED_DECODE_KERNEL,
 )
 from distributed_tensorflow_guide_tpu.ops.flash_attention import (
     NEG_INF,
@@ -356,6 +357,261 @@ def make_decode_runner(blk_k: int, *, b: int, h: int, s: int, d: int,
 
         def call(q, k, v):
             return decode_attention(q, k, v, s - chunk, blk_k=blk_k)
+
+    f = jax.jit(call)
+    return lambda: f(*ops)
+
+
+# --------------------------------------------------------------------------
+# paged variant: the cache is a block POOL, reads ride a block table
+# --------------------------------------------------------------------------
+#
+# The serve engine (serve/engine.py) keeps one pool of fixed-size blocks
+# per layer shared by every resident request (serve/paged_cache.py); a
+# request's cache is whatever blocks its (blocks_per_seq,) table row names.
+# The kernel below is the same online-softmax stream as _decode_kernel with
+# two changes: the length is PER-REQUEST ((B,) — continuous batching puts
+# every slot at its own position), and the KV BlockSpec index map resolves
+# physical blocks through the table — both ride in as scalar-prefetch
+# operands, so dead blocks still collapse onto the last live physical
+# block and elide their DMA exactly as in the contiguous kernel. blk_k
+# must divide the pool block size: a tile never straddles two physical
+# blocks, which is what keeps the index map a pure table lookup.
+
+
+def paged_decode_blk_k_for(*, b: int, h: int, s: int, d: int, dtype,
+                           block_size: int,
+                           platform: str | None = None) -> int:
+    """KV edge for the paged kernel: the ``decode_paged`` table entry when
+    one exists AND divides the pool block size, else the largest tested
+    default that does. Key: s = max_len (the logical view the grid spans),
+    dtype = the CACHE dtype."""
+    hit = autotune.lookup(PAGED_DECODE_KERNEL, b=b, h=h, s=s, d=d,
+                          dtype=dtype, causal=False, platform=platform)
+    if hit is not None and block_size % hit[1] == 0:
+        return hit[1]
+    for cand in (DEFAULT_DECODE_BLK_K, 128, 64, 32, 16, 8):
+        if cand <= block_size and block_size % cand == 0:
+            return cand
+    return block_size
+
+
+def ensure_paged_decode_tuned(*, b: int, h: int, s: int, d: int, dtype,
+                              block_size: int, iters: int = 20,
+                              platform: str | None = None) -> int:
+    """Sweep-and-record the paged KV edge (refused on CPU, same contract
+    as every sweep). Candidates that do not divide the pool block size
+    are rejected inside ``measure`` so the shared sweep machinery skips
+    them as failed candidates."""
+
+    def measure(kern, blocks):
+        if block_size % blocks[1]:
+            raise ValueError(
+                f"blk_k {blocks[1]} does not divide block_size "
+                f"{block_size}")
+        fn = make_paged_decode_runner(blocks[1], b=b, h=h, s=s, d=d,
+                                      dtype=dtype, block_size=block_size)
+        return autotune.measure_runner(fn, iters=iters)
+
+    blocks = autotune.ensure_tuned(
+        PAGED_DECODE_KERNEL, b=b, h=h, s=s, d=d, dtype=dtype, causal=False,
+        iters=iters, measure=measure, platform=platform)
+    return blocks[1]
+
+
+def paged_supported(s: int, block_size: int, blk_k: int,
+                    chunk: int = 1) -> bool:
+    """:func:`supported` plus the pool constraint: the KV edge divides the
+    physical block size (tiles never straddle blocks)."""
+    return (supported(s, blk_k, chunk) and block_size % blk_k == 0
+            and s % block_size == 0)
+
+
+def _paged_decode_kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, *refs,
+                         scale: float, blk_k: int, chunk: int,
+                         quantized: bool):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b]  # per-request live length (continuous batching)
+
+    @pl.when(j * blk_k < length)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)  # (Cp, hd)
+        k = k_ref[0, 0].astype(jnp.float32)  # (blk_k, hd)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (Cp, blk_k)
+        if quantized:
+            s = s * ks_ref[0, 0]  # (1, blk_k) broadcast
+        cp = q.shape[0]
+        rows = jnp.minimum(
+            jax.lax.broadcasted_iota(jnp.int32, (cp, blk_k), 0), chunk - 1)
+        q_pos = (length - chunk) + rows
+        k_pos = j * blk_k + jax.lax.broadcasted_iota(
+            jnp.int32, (cp, blk_k), 1)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        l_scr[:] = jnp.broadcast_to(l_prev * alpha
+                                    + jnp.sum(p, axis=1, keepdims=True),
+                                    l_scr.shape)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        if quantized:
+            p = p * vs_ref[0, 0]
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_kv - 1)
+    def _():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, key_pool, value_pool, block_tables, lengths,
+                           *, key_scale_pool=None, value_scale_pool=None,
+                           block_size: int, blk_k: int | None = None):
+    """Length-aware cache attention reading a paged pool through tables.
+
+    ``q``: (B, C, H, hd) public layout. ``key_pool``/``value_pool``:
+    (num_blocks, H, block_size, hd) kernel layout (int8 with
+    (num_blocks, H, 1, block_size) f32 scale pools when quantized).
+    ``block_tables``: (B, blocks_per_seq) int32 physical block ids.
+    ``lengths``: (B,) int32 per-request live lengths AFTER the chunk's
+    write — request b's chunk occupies logical positions
+    [lengths[b] - C, lengths[b]). Only reads; the caller scatters the
+    chunk first (models/transformer.py _paged_decode_attend).
+    Returns (B, C, H, hd) in q's dtype.
+    """
+    B, C, H, hd = q.shape
+    n_blk = block_tables.shape[1]
+    S = n_blk * block_size
+    quantized = key_scale_pool is not None
+    if quantized != (value_scale_pool is not None):
+        raise ValueError("key/value scale pools must be given together")
+    if blk_k is None:
+        blk_k = paged_decode_blk_k_for(b=B, h=H, s=S, d=hd,
+                                       dtype=key_pool.dtype,
+                                       block_size=block_size)
+    if not paged_supported(S, block_size, blk_k, C):
+        raise ValueError(
+            f"paged_decode_attention: blk_k {blk_k} / chunk {C} "
+            f"unsupported for view length {S}, block_size {block_size} — "
+            "callers gate on paged_supported() and fall back to the "
+            "gathered dense path")
+    cp = -(-C // DECODE_CHUNK_SUBLANES) * DECODE_CHUNK_SUBLANES
+    qk = jnp.transpose(q, (0, 2, 1, 3))  # (B, H, C, hd)
+    if cp != C:
+        qk = jnp.pad(qk, ((0, 0), (0, 0), (0, cp - C), (0, 0)))
+    lengths = jnp.maximum(jnp.asarray(lengths, jnp.int32), 1)
+    tables = jnp.asarray(block_tables, jnp.int32)
+    scale = 1.0 / (hd ** 0.5)
+    n_kv = S // blk_k
+    sub = block_size // blk_k  # kernel tiles per physical block
+
+    def live_j(b, j, len_ref):
+        # same revisit trick as the contiguous kernel: dead tiles map to
+        # the last live tile so consecutive identical (block, offset)
+        # pairs elide the DMA
+        last_live = (len_ref[b] + blk_k - 1) // blk_k - 1
+        return jnp.minimum(j, last_live)
+
+    def kv_map(b, h, j, len_ref, bt_ref):
+        lj = live_j(b, j, len_ref)
+        return (bt_ref[b, lj // sub], h, lj % sub, 0)
+
+    def sc_map(b, h, j, len_ref, bt_ref):
+        lj = live_j(b, j, len_ref)
+        return (bt_ref[b, lj // sub], h, 0, lj % sub)
+
+    q_spec = _vmem_spec((1, 1, cp, hd),
+                        lambda b, h, j, L, T: (b, h, 0, 0))
+    kv_spec = _vmem_spec((1, 1, blk_k, hd), kv_map)
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qk, key_pool, value_pool]
+    if quantized:
+        sc_spec = _vmem_spec((1, 1, 1, blk_k), sc_map)
+        in_specs += [sc_spec, sc_spec]
+        operands += [key_scale_pool, value_scale_pool]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, n_kv),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        scratch_shapes=[
+            _vmem_scratch((cp, LANE), jnp.float32),
+            _vmem_scratch((cp, LANE), jnp.float32),
+            _vmem_scratch((cp, hd), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_decode_kernel, scale=scale,
+                               blk_k=blk_k, chunk=C, quantized=quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, cp, hd), q.dtype),
+        interpret=_interpret(),
+    )(lengths, tables, *operands)
+    return jnp.transpose(out[:, :, :C], (0, 2, 1, 3))
+
+
+def make_paged_decode_runner(blk_k: int, *, b: int, h: int, s: int,
+                             d: int, dtype, block_size: int,
+                             chunk: int = 1, seed: int = 0):
+    """Zero-arg runner for ONE paged decode-attention call: a full pool
+    (every request at length s — the steady-state worst case), identity
+    block tables. The unit the paged sweep and the kernel microbench
+    time."""
+    quantized = jnp.dtype(dtype) == jnp.dtype(jnp.int8)
+    q_dtype = jnp.bfloat16 if quantized else dtype
+    n_blk = s // block_size
+    num_blocks = b * n_blk + 1  # +1: the trash block convention
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(keys[0], (b, chunk, h, d),
+                          jnp.float32).astype(q_dtype)
+    kf = jax.random.normal(keys[1], (num_blocks, h, block_size, d),
+                           jnp.float32)
+    vf = jax.random.normal(keys[2], (num_blocks, h, block_size, d),
+                           jnp.float32)
+    tables = jnp.arange(b * n_blk, dtype=jnp.int32).reshape(b, n_blk)
+    lengths = jnp.full((b,), s, jnp.int32)
+    if quantized:
+        k8, ks = quantize_kv(kf)
+        v8, vs = quantize_kv(vf)
+        ops = (q, k8, v8, ks[:, :, None, :], vs[:, :, None, :])
+
+        def call(q, k8, v8, ks, vs):
+            return paged_decode_attention(
+                q, k8, v8, tables, lengths, key_scale_pool=ks,
+                value_scale_pool=vs, block_size=block_size, blk_k=blk_k)
+    else:
+        ops = (q, kf.astype(dtype), vf.astype(dtype))
+
+        def call(q, k, v):
+            return paged_decode_attention(
+                q, k, v, tables, lengths, block_size=block_size,
+                blk_k=blk_k)
 
     f = jax.jit(call)
     return lambda: f(*ops)

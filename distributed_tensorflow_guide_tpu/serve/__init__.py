@@ -1,0 +1,36 @@
+"""Continuous-batching serving: paged KV pool + request scheduler +
+two static step programs (see docs/serving.md)."""
+
+from distributed_tensorflow_guide_tpu.serve.engine import (
+    Event,
+    ServeEngine,
+    build_step_fns,
+    paged_cache_pool,
+    paged_config,
+)
+from distributed_tensorflow_guide_tpu.serve.paged_cache import (
+    BlockPool,
+    blocks_for,
+    gather_view,
+    scatter_chunk,
+    table_row,
+)
+from distributed_tensorflow_guide_tpu.serve.scheduler import (
+    Request,
+    Scheduler,
+)
+
+__all__ = [
+    "BlockPool",
+    "Event",
+    "Request",
+    "Scheduler",
+    "ServeEngine",
+    "blocks_for",
+    "build_step_fns",
+    "gather_view",
+    "paged_cache_pool",
+    "paged_config",
+    "scatter_chunk",
+    "table_row",
+]

@@ -1,0 +1,175 @@
+"""Paged KV cache: a fixed pool of fixed-size blocks + per-request tables.
+
+The one-shot serving path (models/generation.py) gives every request a
+private ``(B, max_len, H, hd)`` cache buffer for its whole lifetime —
+HBM is reserved for ``max_len`` slots even while a request has written
+eight.  Production traffic (ROADMAP item 1's "millions of users") makes
+that the binding constraint on batch size, which is the vLLM observation:
+page the cache.  Here the cache collection of every attention layer
+becomes a POOL of ``num_blocks`` fixed-size blocks shared by all resident
+requests, and each request owns a **block table** — a row of physical
+block ids covering its logical positions ``[0, max_len)``.
+
+The split of responsibilities keeps every compiled shape static:
+
+* **host Python** (:class:`BlockPool`) allocates, frees and evicts blocks
+  — a free-list the scheduler drives between steps; nothing here traces;
+* **device code** (:func:`gather_view` / :func:`scatter_chunk`) reads and
+  writes through the table *inside* the compiled step: a gather by block
+  id materializes a request's logical cache view, a scatter by
+  ``table[pos // bs] * bs + pos % bs`` writes a chunk — both are plain
+  static-shape XLA ops, so the engine's step program never retraces as
+  the resident population changes.
+
+Unallocated logical blocks point at the reserved **trash block** (the
+pool's last id): inactive decode slots write there and the attention
+mask hides anything read from it, so the device program needs no branch
+on liveness.  The helpers are layout-agnostic (``seq_axis`` names the
+blocked axis) because the cache collection has three leaf layouts —
+legacy ``(B, S, H, hd)``, kernel ``(B, H, S, hd)`` and the quantized
+scale rows ``(B, H, 1, S)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+# --------------------------------------------------------------------------
+# device-side: gather / scatter through a block table
+# --------------------------------------------------------------------------
+
+
+def gather_view(pool, tables, *, seq_axis: int):
+    """Materialize per-request logical cache views from the pool.
+
+    ``pool`` is ``(num_blocks, *dims)`` where ``dims[seq_axis - 1]`` is the
+    block size; ``tables`` is ``(B, blocks_per_seq)`` int32 physical block
+    ids.  Returns ``(B, *dims)`` with the blocked axis expanded to
+    ``blocks_per_seq * block_size`` at ``seq_axis`` — the exact dense view
+    the one-shot cache holds, which is what pins the fallback path
+    token-identical on CPU.
+    """
+    g = jnp.take(pool, tables, axis=0)  # (B, n_blk, *dims)
+    g = jnp.moveaxis(g, 1, seq_axis)
+    shape = list(g.shape)
+    merged = (shape[:seq_axis]
+              + [shape[seq_axis] * shape[seq_axis + 1]]
+              + shape[seq_axis + 2:])
+    return g.reshape(merged)
+
+
+def scatter_chunk(pool, chunk, tables, index, *, block_size: int,
+                  seq_axis: int):
+    """Write per-request chunks into the pool through the block tables.
+
+    ``chunk`` is ``(B, *dims)`` with C positions along ``seq_axis``;
+    request b's chunk lands at logical positions ``[index[b],
+    index[b] + C)``, i.e. physical row ``tables[b, p // bs] * bs +
+    p % bs`` of the block-flattened pool.  Rows of requests whose table
+    points at the trash block land there harmlessly (never read back).
+    Static shapes; one scatter.
+    """
+    B = chunk.shape[0]
+    C = chunk.shape[seq_axis]
+    pos = index[:, None] + jnp.arange(C)[None, :]  # (B, C)
+    phys = jnp.take_along_axis(tables, pos // block_size, axis=1)
+    lin = phys * block_size + pos % block_size  # (B, C) flattened rows
+    p = jnp.moveaxis(pool, seq_axis, 1)  # (N, bs, *rest)
+    rest = p.shape[2:]
+    flat = p.reshape((p.shape[0] * block_size,) + rest)
+    rows = jnp.moveaxis(chunk, seq_axis, 1).reshape((B * C,) + rest)
+    flat = flat.at[lin.reshape(-1)].set(rows)
+    p = flat.reshape((pool.shape[0], block_size) + rest)
+    return jnp.moveaxis(p, 1, seq_axis)
+
+
+# --------------------------------------------------------------------------
+# host-side: the allocator the scheduler drives
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BlockPool:
+    """Host-side block allocator: free-list + ownership ledger.
+
+    ``num_blocks`` includes the reserved trash block (the LAST id), which
+    is never handed out — ``capacity`` is what requests can actually own.
+    Deterministic: blocks are allocated lowest-id-first, so an identical
+    request trace produces identical tables (the scheduler-determinism
+    test pins this).  The ownership ledger makes aliasing structurally
+    impossible: every alloc records an owner, every free checks it.
+    """
+
+    num_blocks: int
+    block_size: int
+
+    def __post_init__(self) -> None:
+        if self.num_blocks < 2:
+            raise ValueError("need >= 2 blocks (one is the trash block)")
+        self._free: list[int] = sorted(range(self.num_blocks - 1),
+                                       reverse=True)
+        self._owner: dict[int, int] = {}  # block id -> request id
+
+    @property
+    def trash_block(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def capacity(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def live_blocks(self) -> int:
+        return len(self._owner)
+
+    def owned_by(self, rid: int) -> list[int]:
+        return sorted(b for b, o in self._owner.items() if o == rid)
+
+    def alloc(self, rid: int, n: int) -> list[int] | None:
+        """``n`` blocks for request ``rid``, lowest ids first — or None
+        (and no state change) when the pool cannot satisfy it."""
+        if n > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        for b in got:
+            self._owner[b] = rid
+        return got
+
+    def free(self, rid: int, blocks: list[int]) -> None:
+        for b in blocks:
+            if self._owner.get(b) != rid:
+                raise ValueError(
+                    f"request {rid} freeing block {b} it does not own "
+                    f"(owner: {self._owner.get(b)})")
+            del self._owner[b]
+            self._free.append(b)
+        self._free.sort(reverse=True)
+
+    def check_leaks(self) -> None:
+        """Every block accounted for exactly once (the accounting test)."""
+        if len(self._free) + len(self._owner) != self.capacity:
+            raise AssertionError(
+                f"block leak: {len(self._free)} free + "
+                f"{len(self._owner)} owned != {self.capacity}")
+        if set(self._free) & set(self._owner):
+            raise AssertionError("block aliased free AND owned")
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``tokens`` cache slots."""
+    return -(-tokens // block_size)
+
+
+def table_row(blocks: list[int], blocks_per_seq: int,
+              trash: int) -> np.ndarray:
+    """A request's table row: its physical blocks in logical order, the
+    unallocated tail pointing at the trash block."""
+    row = np.full((blocks_per_seq,), trash, np.int32)
+    row[:len(blocks)] = np.asarray(blocks, np.int32)
+    return row

@@ -1,0 +1,283 @@
+"""Request scheduler: continuous batching over a fixed-slot decode batch.
+
+All of the *dynamic* serving state lives here, on the host, in plain
+Python — which requests are resident, which physical blocks they own,
+how far each one has written — so the device programs
+(serve/engine.py) stay fully static: a decode step always runs all
+``slots`` rows, a prefill step always runs one ``prefill_chunk``-token
+chunk. The scheduler changes the POPULATION between steps (Orca's
+iteration-level scheduling): a finished request frees its slot and
+blocks at the step boundary, a queued prompt is admitted into any empty
+slot mid-flight, and nothing retraces.
+
+Decisions are deterministic functions of the submitted trace: FIFO
+admission by arrival time, lowest-id slots and blocks first, preemption
+evicts the MOST RECENTLY admitted victim (its re-queued continuation
+carries the original prompt plus everything already emitted, and the
+position-derived sampling keys of models/generation.py make the
+regenerated stream bitwise the one it would have produced uninterrupted
+— eviction is free of replay divergence by construction). The
+scheduler-determinism test replays a seeded arrival trace twice and
+pins identical event logs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from distributed_tensorflow_guide_tpu.serve.paged_cache import (
+    BlockPool,
+    blocks_for,
+)
+
+PREFILL, DECODE = "prefill", "decode"
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request. ``rng`` is the request's own PRNG key (raw
+    (2,) uint32, what ``jax.random.PRNGKey`` returns) — sampling keys
+    derive from (rng, absolute position), which is what makes the
+    engine's per-request stream bitwise a one-shot
+    ``make_generate_fn(...)​(params, prompt[None], rng)`` run."""
+
+    rid: int
+    prompt: np.ndarray  # (P,) int32
+    max_new_tokens: int
+    rng: np.ndarray  # (2,) uint32
+    arrival: float = 0.0
+
+
+@dataclasses.dataclass
+class _Slot:
+    rid: int
+    prompt: np.ndarray  # current prompt (original + pre-preemption emits)
+    budget: int  # tokens still to emit from THIS residency
+    rng: np.ndarray
+    blocks: list[int]
+    phase: str = PREFILL
+    chunk_cursor: int = 0  # next prefill chunk index
+    written: int = 0  # cache positions written so far
+    pending: int = 0  # last sampled token (k/v not yet written)
+    emitted_here: int = 0  # tokens emitted during THIS residency
+    admitted_seq: int = 0
+
+
+class Scheduler:
+    """Slots + pool + queue; the engine asks it what to run each tick."""
+
+    def __init__(self, *, slots: int, num_blocks: int, block_size: int,
+                 prefill_chunk: int, max_len: int) -> None:
+        if max_len % prefill_chunk:
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} must divide max_len "
+                f"{max_len} (pad writes must stay inside the table)")
+        if max_len % block_size:
+            raise ValueError(
+                f"block_size {block_size} must divide max_len {max_len}")
+        self.slots: list[_Slot | None] = [None] * slots
+        self.pool = BlockPool(num_blocks, block_size)
+        self.block_size = block_size
+        self.prefill_chunk = prefill_chunk
+        self.max_len = max_len
+        self.blocks_per_seq = max_len // block_size
+        self.queue: list[Request] = []  # FIFO; preemptions go to the front
+        self.emitted: dict[int, list[int]] = {}  # rid -> all emitted tokens
+        self.first_emit: dict[int, bool] = {}  # rid -> saw first token yet
+        self.done: set[int] = set()
+        self._seq = 0  # admission counter (preemption picks the youngest)
+        self._prefer_prefill = True  # interleave chunked prefill w/ decode
+        self.preemptions = 0
+
+    # ---- intake ----------------------------------------------------------
+
+    def max_request_blocks(self, prompt_len: int, max_new: int) -> int:
+        padded = -(-prompt_len // self.prefill_chunk) * self.prefill_chunk
+        return blocks_for(max(padded, prompt_len + max_new),
+                          self.block_size)
+
+    def submit(self, req: Request) -> None:
+        P = int(len(req.prompt))
+        if P < 1:
+            raise ValueError("empty prompt")
+        if P + req.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {P} + max_new {req.max_new_tokens} exceeds "
+                f"max_len {self.max_len}")
+        if self.max_request_blocks(P, req.max_new_tokens) > \
+                self.pool.capacity:
+            raise ValueError(
+                f"request {req.rid} can never fit: needs "
+                f"{self.max_request_blocks(P, req.max_new_tokens)} blocks, "
+                f"pool capacity {self.pool.capacity}")
+        self.queue.append(req)
+        self.emitted.setdefault(req.rid, [])
+        self.first_emit.setdefault(req.rid, False)
+
+    # ---- admission -------------------------------------------------------
+
+    def admit(self, now: float) -> list[int]:
+        """FIFO head-of-line admission: fill empty slots with arrived
+        requests whose prefill footprint fits the pool right now. Strict
+        FIFO (no reordering past the head) keeps admission latency fair
+        and the trace deterministic."""
+        admitted = []
+        while self.queue and None in self.slots:
+            req = self.queue[0]
+            if req.arrival > now:
+                break
+            P = len(req.prompt)
+            padded = -(-P // self.prefill_chunk) * self.prefill_chunk
+            blocks = self.pool.alloc(req.rid, blocks_for(padded,
+                                                         self.block_size))
+            if blocks is None:
+                break
+            self.queue.pop(0)
+            s = self.slots.index(None)
+            self.slots[s] = _Slot(
+                rid=req.rid, prompt=np.asarray(req.prompt, np.int32),
+                budget=req.max_new_tokens, rng=req.rng, blocks=blocks,
+                admitted_seq=self._seq)
+            self._seq += 1
+            admitted.append(s)
+        return admitted
+
+    # ---- tick planning ---------------------------------------------------
+
+    def plan(self) -> tuple[str, object]:
+        """What the engine should launch this tick: ``("prefill", slot)``
+        one chunk for the oldest mid-prefill slot, ``("decode", [slots])``
+        one decode step over the active population, or ``("idle", None)``.
+        When both phases have work they ALTERNATE (chunked prefill
+        interleaved with decode — a long prompt no longer stalls every
+        resident stream for its whole prefill)."""
+        prefills = [i for i, s in enumerate(self.slots)
+                    if s is not None and s.phase == PREFILL]
+        decodes = [i for i, s in enumerate(self.slots)
+                   if s is not None and s.phase == DECODE]
+        if prefills and (self._prefer_prefill or not decodes):
+            self._prefer_prefill = False
+            best = min(prefills,
+                       key=lambda i: self.slots[i].admitted_seq)
+            return (PREFILL, best)
+        if decodes:
+            self._prefer_prefill = bool(prefills)
+            ready = self._grow_for_decode(decodes)
+            if ready:
+                return (DECODE, ready)
+            prefills = [i for i, s in enumerate(self.slots)
+                        if s is not None and s.phase == PREFILL]
+            if prefills:
+                best = min(prefills,
+                           key=lambda i: self.slots[i].admitted_seq)
+                return (PREFILL, best)
+        return ("idle", None)
+
+    def _grow_for_decode(self, decodes: list[int]) -> list[int]:
+        """Every decoding slot must own the block its next write lands in;
+        grow by one block where needed, preempting the youngest other
+        resident when the pool is dry."""
+        ready = []
+        for i in list(decodes):
+            slot = self.slots[i]
+            if slot is None:  # preempted by an earlier growth this tick
+                continue
+            while len(slot.blocks) * self.block_size < slot.written + 1:
+                got = self.pool.alloc(slot.rid, 1)
+                if got is not None:
+                    slot.blocks.extend(got)
+                    continue
+                victim = self._pick_victim(exclude=i)
+                if victim is None:
+                    break  # stalled: no blocks, nothing to preempt
+                self._preempt(victim)
+            else:
+                ready.append(i)
+        return [i for i in ready if self.slots[i] is not None]
+
+    def _pick_victim(self, exclude: int) -> int | None:
+        live = [(s.admitted_seq, i) for i, s in enumerate(self.slots)
+                if s is not None and i != exclude and s.blocks]
+        if not live:
+            return None
+        return max(live)[1]  # youngest admission goes first
+
+    def _preempt(self, i: int) -> None:
+        slot = self.slots[i]
+        self.pool.free(slot.rid, slot.blocks)
+        # continuation request: this residency's prompt plus every token
+        # it emitted; budget = whatever is still owed. Position-derived
+        # sampling keys make the re-run emit exactly the tokens it would
+        # have produced uninterrupted, so preemption never forks the
+        # stream. Goes to the FRONT of the queue (it was already served).
+        cont_prompt = slot.prompt
+        if slot.emitted_here:
+            tail = self.emitted[slot.rid][-slot.emitted_here:]
+            cont_prompt = np.concatenate(
+                [slot.prompt, np.asarray(tail, np.int32)])
+        self.queue.insert(0, Request(
+            rid=slot.rid, prompt=cont_prompt,
+            max_new_tokens=slot.budget, rng=slot.rng,
+            arrival=float("-inf")))
+        self.slots[i] = None
+        self.preemptions += 1
+
+    # ---- result application ---------------------------------------------
+
+    def prefill_done_chunks(self, slot_idx: int) -> int:
+        s = self.slots[slot_idx]
+        return -(-len(s.prompt) // self.prefill_chunk)
+
+    def apply_prefill(self, slot_idx: int, token: int) -> list[tuple]:
+        """One chunk finished for ``slot_idx``; ``token`` is the program's
+        sample from the chunk's last valid row (meaningful only on the
+        final chunk). Returns [(rid, token, first, done)] events."""
+        s = self.slots[slot_idx]
+        s.chunk_cursor += 1
+        s.written = min(s.chunk_cursor * self.prefill_chunk,
+                        len(s.prompt))
+        if s.chunk_cursor < self.prefill_done_chunks(slot_idx):
+            return []
+        # final chunk: the sample at position P is the first new token
+        s.written = len(s.prompt)
+        s.phase = DECODE
+        s.pending = int(token)
+        return self._emit(slot_idx, int(token))
+
+    def apply_decode(self, slot_idx: int, token: int) -> list[tuple]:
+        s = self.slots[slot_idx]
+        s.written += 1  # the step wrote pending's k/v at `written`
+        s.pending = int(token)
+        return self._emit(slot_idx, int(token))
+
+    def _emit(self, slot_idx: int, token: int) -> list[tuple]:
+        s = self.slots[slot_idx]
+        rid = s.rid
+        self.emitted[rid].append(token)
+        first = not self.first_emit[rid]
+        self.first_emit[rid] = True
+        s.budget -= 1
+        s.emitted_here += 1
+        done = s.budget == 0
+        if done:
+            self.pool.free(rid, s.blocks)
+            self.slots[slot_idx] = None
+            self.done.add(rid)
+        return [(rid, token, first, done)]
+
+    # ---- introspection ---------------------------------------------------
+
+    @property
+    def has_resident(self) -> bool:
+        return any(s is not None for s in self.slots)
+
+    @property
+    def has_queued(self) -> bool:
+        return bool(self.queue)
+
+    def next_arrival(self) -> float | None:
+        if not self.queue:
+            return None
+        return float(min(r.arrival for r in self.queue))

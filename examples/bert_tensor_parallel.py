@@ -61,6 +61,10 @@ def main() -> None:
     ap.add_argument("--model-parallel", type=int, default=4)
     ap.add_argument("--layers", type=int, default=4,
                     help="12 = full BERT-base; small default for CPU demo")
+    ap.add_argument("--d-model", type=int, default=768,
+                    help="width (heads must divide it AND be divisible "
+                         "by --model-parallel); d_ff scales with it")
+    ap.add_argument("--heads", type=int, default=12)
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--lr", type=float, default=1e-4)
     ap.add_argument("--data", default=None, metavar="TSV",
@@ -168,6 +172,8 @@ def main() -> None:
     cfg = bert_base(num_classes=2, dtype=jnp.float32)
     cfg = type(cfg)(**{**cfg.__dict__, "num_layers": args.layers,
                        "max_len": args.seq_len,
+                       "d_model": args.d_model, "num_heads": args.heads,
+                       "d_ff": 4 * args.d_model,
                        **({"vocab_size": vocab_size} if vocab_size else {})})
     model = Transformer(cfg)
     tp = TensorParallel(mesh)

@@ -275,3 +275,199 @@ def test_vmem_model_and_candidates_valid():
             assert autotune.kernel_vmem_bytes(
                 autotune.DECODE_KERNEL, bq, bk, 128,
                 jnp.int8) <= autotune.VMEM_BUDGET_BYTES
+
+
+# ---- paged pool variant (serve/) --------------------------------------------
+
+
+def _paged(k, v, bs, *, ks=None, vs=None, seed=11):
+    """Scatter dense (B, H, S, hd) caches into a SHUFFLED physical pool
+    plus the block tables mapping them back — non-identity tables are the
+    point: the kernel must resolve every tile through the indirection."""
+    k, v = np.asarray(k), np.asarray(v)
+    b, h, s, hd = k.shape
+    n_blk = s // bs
+    perm = np.random.RandomState(seed).permutation(b * n_blk)
+    nb = b * n_blk + 1  # + the trash block convention
+    kp = np.zeros((nb, h, bs, hd), k.dtype)
+    vp = np.zeros((nb, h, bs, hd), v.dtype)
+    ksp = np.ones((nb, h, 1, bs), np.float32)
+    vsp = np.ones((nb, h, 1, bs), np.float32)
+    tables = np.zeros((b, n_blk), np.int32)
+    for bi in range(b):
+        for j in range(n_blk):
+            p = int(perm[bi * n_blk + j])
+            sl = slice(j * bs, (j + 1) * bs)
+            kp[p], vp[p] = k[bi, :, sl], v[bi, :, sl]
+            if ks is not None:
+                ksp[p, :, 0] = np.asarray(ks)[bi, :, sl]
+                vsp[p, :, 0] = np.asarray(vs)[bi, :, sl]
+            tables[bi, j] = p
+    out = (jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(tables))
+    if ks is not None:
+        out += (jnp.asarray(ksp), jnp.asarray(vsp))
+    return out
+
+
+def test_paged_kernel_matches_dense_oracle_through_shuffled_tables():
+    """Single-token decode against the paged pool: per-request lengths,
+    shuffled block tables, parity with the dense oracle on the contiguous
+    view the tables encode."""
+    k, v = _cache(10)
+    q = _q(seed=12)
+    bs = 32
+    kp, vp, tables = _paged(k, v, bs)
+    for lengths in ([S, S], [42, 97], [1, S]):
+        got = DA.paged_decode_attention(
+            q, kp, vp, tables, jnp.asarray(lengths, jnp.int32),
+            block_size=bs, blk_k=16)
+        for bi, ln in enumerate(lengths):
+            ref = _dense_oracle(q[bi:bi + 1], k[bi:bi + 1],
+                                v[bi:bi + 1], ln - 1)
+            np.testing.assert_allclose(
+                got[bi:bi + 1], ref, atol=1e-5, rtol=1e-5,
+                err_msg=f"req {bi} length {ln}")
+
+
+def test_paged_chunk_parity():
+    """A C>1 chunk (chunked prefill / the serve prefill program) through
+    the paged kernel: request b's chunk occupies logical positions
+    [lengths[b] - C, lengths[b]) with intra-chunk causality."""
+    k, v = _cache(16)
+    c = 4
+    q = _q(c=c, seed=17)
+    bs = 32
+    kp, vp, tables = _paged(k, v, bs)
+    lengths = [60, S]
+    got = DA.paged_decode_attention(
+        q, kp, vp, tables, jnp.asarray(lengths, jnp.int32),
+        block_size=bs, blk_k=16)
+    assert got.shape == (B, c, H, HD)
+    for bi, ln in enumerate(lengths):
+        ref = _dense_oracle(q[bi:bi + 1], k[bi:bi + 1], v[bi:bi + 1],
+                            ln - c)
+        np.testing.assert_allclose(got[bi:bi + 1], ref, atol=1e-5,
+                                   rtol=1e-5, err_msg=f"req {bi}")
+
+
+def test_paged_int8_parity():
+    """Quantized pool (int8 blocks + f32 scale blocks in the pool's
+    (N, H, 1, bs) layout) vs the dense oracle on the dequantized cache."""
+    k, v = _cache(13)
+    k8, ks = DA.quantize_kv(k)
+    v8, vs = DA.quantize_kv(v)
+    kd = k8.astype(jnp.float32) * ks[..., None]
+    vd = v8.astype(jnp.float32) * vs[..., None]
+    q = _q(seed=14)
+    bs = 32
+    k8p, v8p, tables, ksp, vsp = _paged(k8, v8, bs, ks=ks, vs=vs)
+    lengths = [77, 33]
+    got = DA.paged_decode_attention(
+        q, k8p, v8p, tables, jnp.asarray(lengths, jnp.int32),
+        key_scale_pool=ksp, value_scale_pool=vsp, block_size=bs,
+        blk_k=16)
+    for bi, ln in enumerate(lengths):
+        ref = _dense_oracle(q[bi:bi + 1], kd[bi:bi + 1], vd[bi:bi + 1],
+                            ln - 1)
+        np.testing.assert_allclose(got[bi:bi + 1], ref, atol=1e-5,
+                                   rtol=1e-5, err_msg=f"req {bi}")
+
+
+def test_paged_dead_blocks_cannot_leak():
+    """Pool contents past a request's length — whole dead blocks AND the
+    dead tail of its last partially-live block (what freed/stale blocks
+    actually hold) — must not perturb one output bit."""
+    k, v = _cache(14)
+    q = _q(seed=15)
+    bs = 32
+    kp, vp, tables = _paged(k, v, bs)
+    lengths = jnp.asarray([42, 10], jnp.int32)
+    want = DA.paged_decode_attention(q, kp, vp, tables, lengths,
+                                     block_size=bs, blk_k=16)
+    kp2, vp2 = np.asarray(kp).copy(), np.asarray(vp).copy()
+    for bi in range(B):
+        ln = int(lengths[bi])
+        for j in range(tables.shape[1]):
+            p = int(tables[bi, j])
+            if j * bs >= ln:  # fully dead block
+                kp2[p], vp2[p] = 1e6, -1e6
+            elif (j + 1) * bs > ln:  # partially live: poison the tail
+                kp2[p, :, ln - j * bs:] = 1e6
+                vp2[p, :, ln - j * bs:] = -1e6
+    got = DA.paged_decode_attention(q, jnp.asarray(kp2),
+                                    jnp.asarray(vp2), tables, lengths,
+                                    block_size=bs, blk_k=16)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_paged_blk_k_resolution_and_supported():
+    # a tuned edge that divides the pool block is honored
+    autotune._mem[autotune._key(autotune.PAGED_DECODE_KERNEL, 0, 0, S,
+                                HD, "float32", False, "cpu")] = {
+        "blk_q": 8, "blk_k": 16}
+    assert DA.paged_decode_blk_k_for(b=B, h=H, s=S, d=HD,
+                                     dtype=jnp.float32,
+                                     block_size=32) == 16
+    # a tuned edge that would straddle physical blocks is ignored: the
+    # divisor ladder picks the largest default that fits the block
+    autotune._mem[autotune._key(autotune.PAGED_DECODE_KERNEL, 0, 0, S,
+                                HD, "float32", False, "cpu")] = {
+        "blk_q": 8, "blk_k": 64}
+    assert DA.paged_decode_blk_k_for(b=B, h=H, s=S, d=HD,
+                                     dtype=jnp.float32,
+                                     block_size=32) == 32
+    assert DA.paged_supported(S, 32, 16)
+    assert not DA.paged_supported(S, 32, 64)  # tile straddles blocks
+    assert not DA.paged_supported(120, 32, 16)  # ragged final block
+    assert not DA.paged_supported(S, 32, 16,
+                                  chunk=autotune.DECODE_MAX_CHUNK + 1)
+    # a straddling blk_k is refused outright at call time
+    with pytest.raises(ValueError, match="unsupported"):
+        DA.paged_decode_attention(
+            _q(seed=19), jnp.zeros((9, H, 32, HD)),
+            jnp.zeros((9, H, 32, HD)),
+            jnp.zeros((B, 4), jnp.int32), jnp.asarray([1, 1]),
+            block_size=32, blk_k=64)
+
+
+def test_paged_sweep_skips_straddling_candidates_and_cpu_refusal():
+    # the CPU platform refuses to sweep (tier-1 defaults-only contract,
+    # same as the contiguous decode sweep)
+    with pytest.raises(RuntimeError, match="defaults-only"):
+        DA.ensure_paged_decode_tuned(b=1, h=1, s=S, d=16,
+                                     dtype=jnp.float32, block_size=64)
+    # under the tpu key the sweep runs; the blk_k=128 candidate straddles
+    # the 64-slot block and must be skipped as failed, not crash the row
+    best = DA.ensure_paged_decode_tuned(b=1, h=1, s=S, d=16,
+                                        dtype=jnp.float32, block_size=64,
+                                        iters=1, platform="tpu")
+    assert best == 64
+    entry = autotune._mem[autotune._key(
+        autotune.PAGED_DECODE_KERNEL, 0, 0, S, 16, "float32", False,
+        "tpu")]
+    skipped = {f["blk_k"] for f in entry["detail"]["failed"]}
+    assert skipped == {128}
+    # resolution now serves the recorded edge for the same shape
+    assert DA.paged_decode_blk_k_for(b=1, h=1, s=S, d=16,
+                                     dtype=jnp.float32, block_size=64,
+                                     platform="tpu") == best
+
+
+def test_paged_runner_executes_and_matches_oracle():
+    """The paged sweep/microbench unit drives the REAL kernel on a full
+    identity-table pool; its output must match the dense oracle built
+    from the same seeded operands."""
+    fn = DA.make_paged_decode_runner(16, b=1, h=2, s=64, d=16,
+                                     dtype=jnp.float32, block_size=16)
+    out = jax.block_until_ready(fn())
+    assert out.shape == (1, 1, 2, 16)
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(keys[0], (1, 1, 2, 16), jnp.float32)
+    kf = jax.random.normal(keys[1], (5, 2, 16, 16), jnp.float32)
+    vf = jax.random.normal(keys[2], (5, 2, 16, 16), jnp.float32)
+    kd = jnp.concatenate([kf[j] for j in range(4)], axis=1)[None]
+    vd = jnp.concatenate([vf[j] for j in range(4)], axis=1)[None]
+    scores = jnp.einsum("bqhd,bhkd->bhqk", q, kd) / jnp.sqrt(16.0)
+    ref = jnp.einsum("bhqk,bhkd->bqhd", jax.nn.softmax(scores, -1), vd)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)

@@ -115,6 +115,7 @@ def test_bert_trains_from_labeled_text(tmp_path):
     cmd = [sys.executable, str(REPO / "examples" / "bert_tensor_parallel.py"),
            "--fake-devices", "8", "--make-demo-data", "400",
            "--data", str(tsv), "--steps", "12", "--layers", "2",
+           "--d-model", "128", "--heads", "4",
            "--seq-len", "32", "--global-batch", "16", "--bpe-vocab", "300"]
     r = subprocess.run(cmd, capture_output=True, text=True, timeout=420,
                        env=env, cwd=REPO)
