@@ -32,6 +32,20 @@ Goodput counts only tokens of requests that met BOTH SLOs.
 The headline metric is goodput at the highest offered rate;
 ``vs_baseline`` (continuous mode) is continuous/static at that rate —
 the paged+continuous side strictly improving it is the point.
+
+``--chaos`` adds a serving-under-fire phase (PR 11): the same top-rate
+mix driven through a fresh engine with a seeded fault storm
+(:meth:`FaultSchedule.random_serve` — injected step exceptions, client
+abandons, arrival bursts, pool-pressure spikes) plus admission control
+(``max_queue``). ``--snapshot-restore`` additionally snapshots the
+engine every few ticks, kills it mid-run at ~1/3 of total token
+progress, restores a fresh engine from the latest valid snapshot and
+finishes the workload. Reported: ``recovery_mttr_s`` (virtual seconds
+from kill until token progress catches back up to the kill point),
+``goodput_under_chaos_frac`` (chaos goodput / clean goodput at the same
+rate), ``shed_rate`` and the ``zero_dropped_streams`` verdict (every
+workload request reaches a terminal state — completed, cancelled,
+expired or shed — none silently vanish, even through the kill).
 """
 
 import argparse
@@ -75,6 +89,14 @@ def main() -> None:
     ap.add_argument("--slo-tpot-x", type=float, default=6.0,
                     help="TPOT SLO as a multiple of unloaded TPOT")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--chaos", action="store_true",
+                    help="add the serving-under-fire phase: the top-rate "
+                         "mix against a seeded fault storm + admission "
+                         "control")
+    ap.add_argument("--snapshot-restore", action="store_true",
+                    help="with the chaos phase: periodic engine "
+                         "snapshots, a mid-run kill, restore from the "
+                         "latest valid snapshot (implies --chaos)")
     ap.add_argument("--small", action="store_true")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
@@ -171,6 +193,8 @@ def main() -> None:
         for e in events:
             if e.rid not in arr:
                 continue  # warmup / calibration residue
+            if e.token < 0 or e.status != "ok":
+                continue  # terminal pseudo-events carry no token
             if e.first:
                 firsts[e.rid] = e.time
             lasts[e.rid] = e.time
@@ -265,8 +289,198 @@ def main() -> None:
         lat = [(finish - a, 0.0, n, finish) for _, a, finish, n in done]
         static_good.append(goodput(lat, slo_ttft, slo_tpot, wl[0][1]))
 
-    # ---- the JSON line ---------------------------------------------------
     top = len(rates) - 1
+
+    # ---- chaos phase: serving under fire (PR 11) ------------------------
+    chaos_extras = {}
+    if args.chaos or args.snapshot_restore:
+        import tempfile
+
+        from distributed_tensorflow_guide_tpu.serve.scheduler import (
+            EngineOverloaded,
+        )
+        from distributed_tensorflow_guide_tpu.testing.chaos import (
+            FaultSchedule,
+        )
+
+        burst_rng = np.random.RandomState(args.seed * 104729 + 5)
+        burst_log = []  # rids the storm injected
+
+        def burst_factory(n, burst_now):
+            out = []
+            for _ in range(n):
+                rid = 3_000_000 + len(burst_log)
+                burst_log.append(rid)
+                P = int(burst_rng.choice(plens, p=pmix))
+                toks = burst_rng.randint(
+                    0, cfg.vocab_size, P).astype(np.int32)
+                out.append(Request(
+                    rid=rid, prompt=toks, max_new_tokens=min(mnews),
+                    rng=jax.random.PRNGKey(rid % (1 << 20)),
+                    arrival=burst_now))
+            return out
+
+        snap_dir = (tempfile.mkdtemp(prefix="bench_serve_snap_")
+                    if args.snapshot_restore else None)
+
+        def make_chaos_engine(storm):
+            return ServeEngine(
+                cfg, params, slots=args.slots,
+                num_blocks=args.num_blocks, block_size=args.block_size,
+                prefill_chunk=args.prefill_chunk, temperature=0.0,
+                max_queue=2 * args.slots,
+                chaos=(FaultSchedule.random_serve(
+                    args.seed + 17, max_position=60) if storm else None),
+                burst_factory=burst_factory,
+                snapshot_dir=snap_dir)
+
+        def mkreq(rid, arr, toks, M):
+            return Request(rid=rid, prompt=toks, max_new_tokens=M,
+                           rng=jax.random.PRNGKey(rid % (1 << 20)),
+                           arrival=arr)
+
+        def drive_chaos(pending, e, start_now, *, snap_every=0,
+                        kill_at_tokens=None, progress_rids=None,
+                        progress_target=None):
+            """Closed-loop variant of ``drive``: requests enter at their
+            virtual arrival (so ``max_queue`` gates on real queue depth),
+            shed submissions are recorded, snapshots are taken every
+            ``snap_every`` non-idle ticks, and ``kill_at_tokens`` aborts
+            mid-run once token progress reaches it (the kill leg).
+            ``progress_target`` reports the first virtual time progress
+            over ``progress_rids`` crosses it (the MTTR probe)."""
+            pending = sorted(pending, key=lambda r: r[1])
+            now, events, shed_rids, caught_up = start_now, [], [], None
+
+            def progress():
+                return sum(len(e.sched.emitted.get(r, []))
+                           for r in progress_rids or ())
+
+            while True:
+                while pending and pending[0][1] <= now:
+                    rid, arr, toks, M = pending.pop(0)
+                    try:
+                        e.submit(mkreq(rid, arr, toks, M))
+                    except EngineOverloaded:
+                        shed_rids.append(rid)
+                busy = (e.sched.has_queued or e.sched.has_resident
+                        or e._pressure_holds)
+                if not busy and not pending:
+                    break
+                t0 = time.perf_counter()
+                evs, kind = e.step(now)
+                dt = time.perf_counter() - t0
+                if kind == "idle" and not evs:
+                    if e._pressure_holds:
+                        continue  # holds release by tick, keep stepping
+                    nxt = [t for t in (e.sched.next_arrival(),
+                                       pending[0][1] if pending else None)
+                           if t is not None]
+                    if not nxt:
+                        break
+                    now = max(now, min(nxt))
+                    continue
+                now += dt
+                events.extend(
+                    dataclasses.replace(ev, time=now) for ev in evs)
+                if snap_every and e._tick % snap_every == 0:
+                    e.save_snapshot()
+                if progress_target is not None and caught_up is None \
+                        and progress() >= progress_target:
+                    caught_up = now
+                if kill_at_tokens is not None \
+                        and progress() >= kill_at_tokens:
+                    return dict(events=events, now=now, pending=pending,
+                                shed=shed_rids, killed=True,
+                                caught_up=caught_up)
+            return dict(events=events, now=now, pending=pending,
+                        shed=shed_rids, killed=False, caught_up=caught_up)
+
+        wl = make_workload(rates[top], args.requests, tag=30)
+        wl_rids = [r for r, _, _, _ in wl]
+        total_tokens = sum(M for _, _, _, M in wl)
+        e1 = make_chaos_engine(storm=True)
+        leg1 = drive_chaos(
+            wl, e1, 0.0,
+            snap_every=8 if args.snapshot_restore else 0,
+            kill_at_tokens=(total_tokens // 3
+                            if args.snapshot_restore else None),
+            progress_rids=wl_rids)
+        events, shed_rids = leg1["events"], list(leg1["shed"])
+        mttr, restored_step, e2 = 0.0, None, None
+        if leg1["killed"]:
+            # engine killed: e1 is abandoned where it stood; a fresh
+            # engine restores the latest valid snapshot, clients
+            # re-submit requests the snapshot never saw (they hold no
+            # done=True event), arrivals after the kill proceed as normal
+            kill_now = leg1["now"]
+            kill_progress = sum(
+                len(e1.sched.emitted.get(r, [])) for r in wl_rids)
+            e2 = make_chaos_engine(storm=False)
+            restored_step = e2.restore_latest_snapshot()
+            shed_base = e2.sched.shed  # snapshot-era sheds, already in e1's
+            by_rid = {r[0]: r for r in wl}
+            lost = [by_rid[r] for r in wl_rids
+                    if r not in e2.sched.meta
+                    and r not in e1.sched.finished  # terminal: client saw it
+                    and r not in {p[0] for p in leg1["pending"]}
+                    and r not in shed_rids]
+            leg2 = drive_chaos(
+                lost + list(leg1["pending"]), e2, kill_now,
+                progress_rids=wl_rids, progress_target=kill_progress)
+            events = events + leg2["events"]
+            shed_rids += leg2["shed"]
+            end = leg2["caught_up"] if leg2["caught_up"] else leg2["now"]
+            mttr = end - kill_now
+        fin = (e2 or e1).sched
+
+        def emitted_of(r):
+            return max(len(e1.sched.emitted.get(r, [])),
+                       len(fin.emitted.get(r, [])))
+
+        # distinct-token counts come from the emitted ledger (the event
+        # stream legitimately re-emits the snapshot..kill span bitwise
+        # after a restore; clients dedupe by position), first-seen time
+        # from the event stream (client view)
+        arrmap = {rid: a for rid, a, _, _ in wl}
+        firsts, lasts = {}, {}
+        for ev in events:
+            if ev.rid in arrmap and ev.token >= 0 and ev.status == "ok":
+                firsts.setdefault(ev.rid, ev.time)
+                lasts[ev.rid] = ev.time
+        lat = []
+        for rid, a in arrmap.items():
+            if rid not in firsts:
+                continue
+            n = emitted_of(rid)
+            tpot = ((lasts[rid] - firsts[rid]) / (n - 1)) if n > 1 else 0.0
+            lat.append((firsts[rid] - a, tpot, n, lasts[rid]))
+        chaos_good = goodput(lat, slo_ttft, slo_tpot, wl[0][1])
+        dropped = [r for r in wl_rids
+                   if r not in fin.finished and r not in shed_rids
+                   and r not in e1.sched.finished]
+        shed_total = e1.sched.shed + (
+            (e2.sched.shed - shed_base) if e2 is not None else 0)
+        attempts = len(wl_rids) + len(burst_log)
+        chaos_extras = {
+            "chaos_seed": args.seed + 17,
+            "chaos_faults_fired": len(e1.chaos.fired),
+            "chaos_goodput": round(chaos_good, 2),
+            "goodput_under_chaos_frac": round(
+                chaos_good / cont_good[top], 3) if cont_good[top] else 0.0,
+            "recovery_mttr_s": round(mttr, 4),
+            "snapshot_restored_step": restored_step,
+            "shed_rate": round(shed_total / max(1, attempts), 3),
+            "burst_requests": len(burst_log),
+            "cancelled": fin.cancelled,
+            "expired": fin.expired,
+            "zero_dropped_streams": not dropped,
+            "chaos_health": (e2 or e1).health(),
+        }
+        for e in (e1, e2):
+            if e is not None:
+                e.close()
+    # ---- the JSON line ---------------------------------------------------
     side = cont_good if args.mode == "continuous" else static_good
     other = static_good if args.mode == "continuous" else cont_good
     extras = {
@@ -295,6 +509,7 @@ def main() -> None:
         "static_cache_bytes_per_step": decode_cache_bytes_per_step(
             cfg, args.slots),
     }
+    extras.update(chaos_extras)
     report("serve_goodput", side[top], "tokens/sec",
            baseline=other[top] if other[top] > 0 else None,
            **extras)

@@ -129,8 +129,11 @@ SMOKE = {
         # platform-independent like bench_resilience: the virtual clock
         # charges real measured launch times and skips idle, so the
         # goodput/TTFT/TPOT numbers and the continuous-vs-static A/B are
-        # real on CPU (rates and SLOs self-calibrate to the machine)
-        ["--fake-devices", "1", "--small", "--requests", "6"],
+        # real on CPU (rates and SLOs self-calibrate to the machine);
+        # --chaos/--snapshot-restore run the serving-under-fire phase
+        # (fault storm, mid-run kill, restore) in the same smoke
+        ["--fake-devices", "1", "--small", "--requests", "6",
+         "--chaos", "--snapshot-restore"],
     "bench_lint.py":
         # NOT a liveness stub either: lint is trace-time only, so the
         # smoke run IS the full registry audit at the pinned 8-device

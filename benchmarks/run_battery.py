@@ -169,6 +169,16 @@ BATTERY: list[tuple[str, list[str], int]] = [
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "pallas"], 1800),
+    # serving under fire (PR 11): one knob each — serve_paged + the
+    # chaos storm, then + the mid-run kill/snapshot-restore leg
+    ("serve_chaos",
+     ["benchmarks/bench_serving.py", "--mode", "continuous",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--chaos"], 1800),
+    ("serve_snapshot_restore",
+     ["benchmarks/bench_serving.py", "--mode", "continuous",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--chaos", "--snapshot-restore"], 1800),
     ("ring_attention_1024",
      ["benchmarks/bench_ring_attention.py", "--seq-len", "1024"], 1500),
     ("ring_attention_2048",

@@ -8,6 +8,9 @@ from distributed_tensorflow_guide_tpu.serve.engine import (
     paged_cache_pool,
     paged_config,
 )
+from distributed_tensorflow_guide_tpu.serve.scheduler import (
+    EngineOverloaded,
+)
 from distributed_tensorflow_guide_tpu.serve.paged_cache import (
     BlockPool,
     blocks_for,
@@ -22,6 +25,7 @@ from distributed_tensorflow_guide_tpu.serve.scheduler import (
 
 __all__ = [
     "BlockPool",
+    "EngineOverloaded",
     "Event",
     "Request",
     "Scheduler",

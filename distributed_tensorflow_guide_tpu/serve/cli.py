@@ -68,14 +68,19 @@ def main() -> None:
             max_new_tokens=args.max_new,
             rng=jax.random.PRNGKey(args.seed * 1000 + rid)))
     for ev in eng.run():
+        if ev.status != "ok":
+            print(f"req {ev.rid:3d} ! {ev.status}")
+            continue
         mark = "*" if ev.first else ("." if not ev.done else "$")
         print(f"req {ev.rid:3d} {mark} token {ev.token}")
     print("--")
     for rid, toks in sorted(eng.completions().items()):
         print(f"req {rid}: {toks}")
-    print(f"steps={eng.steps} preemptions={eng.sched.preemptions} "
-          f"live_blocks={eng.live_blocks()}")
+    print(f"steps={eng.steps} health={eng.health()}")
+    # shutdown contract: every block accounted for, loudly
     eng.sched.pool.check_leaks()
+    eng.close()
+    print("pool.check_leaks(): clean")
 
 
 if __name__ == "__main__":

@@ -26,11 +26,34 @@ position ``p`` — which makes every per-request stream bitwise identical
 to a one-shot ``make_generate_fn`` run of that request alone, no matter
 how scheduling interleaved it (the engine-vs-one-shot parity tests pin
 this, greedy and sampled, across the decode levers).
+
+Serving under fire (PR 11) — the same position-derived keys are what
+make every recovery path *bitwise-safe*:
+
+* a transient launch failure (injected ``serve_step_exception`` or a
+  real one) is retried through the shared ``retry_with_backoff`` — the
+  tick's inputs are rebuilt from host state, so the re-run IS the
+  original tick;
+* a hung compiled step becomes :class:`WatchdogTimeout` (pass
+  ``step_deadline_s``) instead of a silent stall, and retries like any
+  transient;
+* cancellation / TTFT / total deadlines are swept at step boundaries
+  (``Scheduler.sweep``) — slot+blocks free with ``check_leaks`` clean;
+* overload is refused at the door (queue-depth gate in the scheduler,
+  predicted-TTFT gate here) with the retriable
+  :class:`EngineOverloaded`;
+* :meth:`ServeEngine.save_snapshot` serializes all HOST state through
+  the manifested/CRC-verified checkpoint path; a killed engine
+  restores the newest valid snapshot and every in-flight stream
+  continues bitwise identical to an uninterrupted run — the block pool
+  is never saved, residents simply re-prefill (the preemption path).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
+import time
 from types import SimpleNamespace
 
 import jax
@@ -38,6 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from distributed_tensorflow_guide_tpu.core.dist import retry_with_backoff
 from distributed_tensorflow_guide_tpu.models.generation import (
     _sample,
     decode_config,
@@ -51,24 +75,39 @@ from distributed_tensorflow_guide_tpu.serve.paged_cache import table_row
 from distributed_tensorflow_guide_tpu.serve.scheduler import (
     DECODE,
     PREFILL,
+    EngineOverloaded,
     Request,
     Scheduler,
 )
+from distributed_tensorflow_guide_tpu.utils.watchdog import (
+    Watchdog,
+    WatchdogTimeout,
+)
 
-__all__ = ["Event", "Request", "ServeEngine", "build_step_fns",
-           "paged_cache_pool", "lint_contracts"]
+__all__ = ["Event", "Request", "ServeEngine", "EngineOverloaded",
+           "WatchdogTimeout", "build_step_fns", "paged_cache_pool",
+           "lint_contracts"]
+
+# pool-pressure chaos faults allocate under this reserved owner id (real
+# rids are non-negative) and release after this many engine ticks
+_CHAOS_RID = -7
+_PRESSURE_HOLD_TICKS = 4
 
 
 @dataclasses.dataclass(frozen=True)
 class Event:
     """One streamed token: ``first`` marks the request's first generated
-    token (TTFT edge), ``done`` its completion."""
+    token (TTFT edge), ``done`` its completion. Terminal lifecycle events
+    (cancellation, deadline breach) carry ``token == -1``, ``done=True``
+    and ``status`` in {"cancelled", "expired"}; real tokens are
+    ``status == "ok"``."""
 
     time: float
     rid: int
     token: int
     first: bool
     done: bool
+    status: str = "ok"
 
 
 def paged_config(cfg: TransformerConfig, *, num_blocks: int,
@@ -181,7 +220,13 @@ class ServeEngine:
 
     def __init__(self, cfg: TransformerConfig, params, *, slots: int,
                  num_blocks: int, block_size: int, prefill_chunk: int,
-                 temperature: float = 0.0, top_k: int | None = None):
+                 temperature: float = 0.0, top_k: int | None = None,
+                 max_queue: int | None = None,
+                 chaos=None, burst_factory=None,
+                 step_deadline_s: float | None = None,
+                 retry_attempts: int = 3,
+                 retry_base_delay_s: float = 0.05,
+                 snapshot_dir=None, snapshot_keep: int = 3):
         self.fns = build_step_fns(
             cfg, slots=slots, num_blocks=num_blocks,
             block_size=block_size, prefill_chunk=prefill_chunk,
@@ -190,11 +235,35 @@ class ServeEngine:
         self.num_slots = slots
         self.sched = Scheduler(
             slots=slots, num_blocks=num_blocks, block_size=block_size,
-            prefill_chunk=prefill_chunk, max_len=self.fns.cfg.max_len)
+            prefill_chunk=prefill_chunk, max_len=self.fns.cfg.max_len,
+            max_queue=max_queue)
         self.pool = paged_cache_pool(self.fns.cfg, slots)
         self._trash_row = table_row(
             [], self.fns.n_blk, self.sched.pool.trash_block)
         self.steps = {"decode": 0, "prefill": 0, "idle": 0}
+        # failure hardening (PR 11)
+        self.chaos = chaos  # a testing.chaos.FaultSchedule (or None)
+        self.burst_factory = burst_factory  # (n, now) -> [Request]
+        self.retry_attempts = retry_attempts
+        self.retry_base_delay_s = retry_base_delay_s
+        self._injected_exc = 0  # pending chaos launch failures
+        self._pressure_holds: list[tuple[float, list[int]]] = []
+        self._tick = 0
+        self._ttft_ewma: float | None = None  # predicted-TTFT shed gate
+        self.last_tick_s = 0.0
+        self._step_deadline_s = step_deadline_s
+        self._watchdog = (Watchdog(name="serve-engine")
+                          if step_deadline_s else None)
+        self.snapshot_dir = snapshot_dir
+        self._ckpt = None
+        self._last_snap = -1
+        if snapshot_dir is not None:
+            # lazy import: orbax only loads when snapshots are in play
+            from distributed_tensorflow_guide_tpu.train.checkpoint import (
+                Checkpointer,
+            )
+            self._ckpt = Checkpointer(snapshot_dir,
+                                      max_to_keep=snapshot_keep)
 
     # ---- intake ----------------------------------------------------------
 
@@ -202,26 +271,96 @@ class ServeEngine:
         prompt = np.asarray(req.prompt, np.int32).reshape(-1)
         if prompt.size and int(prompt.max()) >= self.fns.cfg.vocab_size:
             raise ValueError("prompt token out of vocabulary")
+        # predicted-SLO gate: if recent TTFTs already blow this request's
+        # TTFT budget, admitting it is a guaranteed miss that would ALSO
+        # push every queued request further out — shed at the door
+        # instead (retriable; nothing recorded). Queue-depth shedding
+        # lives in Scheduler.submit behind max_queue.
+        if (req.ttft_deadline_s is not None
+                and self._ttft_ewma is not None
+                and self._ttft_ewma > req.ttft_deadline_s):
+            self.sched.shed += 1
+            raise EngineOverloaded(
+                f"request {req.rid} shed: recent TTFT "
+                f"{self._ttft_ewma:.3f}s exceeds its "
+                f"{req.ttft_deadline_s:.3f}s deadline — retry later")
         self.sched.submit(dataclasses.replace(
             req, prompt=prompt, rng=np.asarray(req.rng, np.uint32)))
+
+    def cancel(self, rid: int) -> bool:
+        """Client abandon: free the stream's slot+blocks at the next step
+        boundary. Returns False for unknown/already-terminal rids."""
+        return self.sched.cancel(rid)
 
     # ---- the tick --------------------------------------------------------
 
     def step(self, now: float = 0.0) -> tuple[list[Event], str]:
-        """Admit arrived requests, launch (at most) one program, apply
-        its results. Returns (events, kind) with kind in
-        {"prefill", "decode", "idle"} — the bench times this call to get
-        per-launch service time."""
+        """One engine tick: apply due chaos faults, sweep lifecycle
+        (cancellations / deadlines), admit arrived requests, launch (at
+        most) one program, apply its results. Returns (events, kind)
+        with kind in {"prefill", "decode", "idle"} — the bench times
+        this call to get per-launch service time."""
+        tick = self._tick
+        self._tick += 1
+        if self.chaos is not None:
+            self._apply_chaos(tick, now)
+        self._release_pressure(tick)
+        events = [Event(now, *t) for t in self.sched.sweep(now)]
         self.sched.admit(now)
         kind, arg = self.sched.plan()
+        t0 = time.perf_counter()
         if kind == PREFILL:
-            events = self._run_prefill(arg, now)
+            events.extend(self._run_prefill(arg, now))
         elif kind == DECODE:
-            events = self._run_decode(arg, now)
-        else:
-            events = []
+            events.extend(self._run_decode(arg, now))
+        self.last_tick_s = time.perf_counter() - t0
         self.steps[kind] += 1
+        for e in events:
+            if e.first and e.status == "ok":
+                arrival = self.sched.meta.get(e.rid, (now, None, None))[0]
+                ttft = max(0.0, now - arrival)
+                if np.isfinite(ttft):
+                    self._ttft_ewma = (
+                        ttft if self._ttft_ewma is None
+                        else 0.8 * self._ttft_ewma + 0.2 * ttft)
         return events, kind
+
+    def _launch(self, fn, tag: str):
+        """One guarded program launch: a per-attempt watchdog deadline
+        (a hung compiled step becomes :class:`WatchdogTimeout`, not a
+        silent stall) wrapped in the shared ``retry_with_backoff`` — a
+        transient failure re-runs the SAME tick bitwise, because every
+        launch input is rebuilt from host state and the sampling keys
+        are position-derived. Injected chaos failures fire BEFORE the
+        program runs (the pool is untouched); a real failure that lands
+        mid-launch on a donating backend is not retriable in place (the
+        pool was donated) — that path recovers via snapshot restore, as
+        docs/serving.md spells out."""
+
+        def attempt():
+            if self._injected_exc:
+                self._injected_exc -= 1
+                from distributed_tensorflow_guide_tpu.testing.chaos import (
+                    ChaosInjectedError,
+                )
+                raise ChaosInjectedError(
+                    f"chaos: injected serve step exception ({tag})")
+            wd = self._watchdog
+            if wd is None:
+                return fn()
+            wd.arm(tag, self._step_deadline_s)
+            try:
+                return fn()
+            except KeyboardInterrupt:
+                wd.check()  # a trip becomes the clean, retriable error
+                raise
+            finally:
+                wd.disarm()
+
+        return retry_with_backoff(
+            attempt, attempts=self.retry_attempts,
+            base_delay_s=self.retry_base_delay_s, max_delay_s=1.0,
+            what=tag)
 
     def _run_prefill(self, i: int, now: float) -> list[Event]:
         s = self.sched.slots[i]
@@ -232,10 +371,12 @@ class ServeEngine:
         chunk[0, :valid] = s.prompt[start:start + valid]
         tables = table_row(s.blocks, self.fns.n_blk,
                            self.sched.pool.trash_block)[None]
-        tok, self.pool = self.fns.prefill(
-            self.params, self.pool, jnp.asarray(tables),
-            jnp.full((1,), start, jnp.int32), jnp.asarray(chunk),
-            jnp.int32(valid), jnp.asarray(s.rng))
+        tok, self.pool = self._launch(
+            lambda: self.fns.prefill(
+                self.params, self.pool, jnp.asarray(tables),
+                jnp.full((1,), start, jnp.int32), jnp.asarray(chunk),
+                jnp.int32(valid), jnp.asarray(s.rng)),
+            tag="serve_prefill_chunk_step")
         return [Event(now, *ev) for ev in
                 self.sched.apply_prefill(i, int(tok))]
 
@@ -252,16 +393,88 @@ class ServeEngine:
             written[i] = s.written
             last_tok[i] = s.pending
             keys[i] = s.rng
-        nxt, self.pool = self.fns.decode(
-            self.params, self.pool, jnp.asarray(tables),
-            jnp.asarray(written), jnp.asarray(last_tok),
-            jnp.asarray(keys))
+        nxt, self.pool = self._launch(
+            lambda: self.fns.decode(
+                self.params, self.pool, jnp.asarray(tables),
+                jnp.asarray(written), jnp.asarray(last_tok),
+                jnp.asarray(keys)),
+            tag="serve_decode_step")
         nxt = np.asarray(nxt)
         events = []
         for i in ready:
             events.extend(Event(now, *ev) for ev in
                           self.sched.apply_decode(i, int(nxt[i])))
         return events
+
+    # ---- chaos application (testing.chaos serve kinds) -------------------
+
+    def _apply_chaos(self, tick: int, now: float) -> None:
+        from distributed_tensorflow_guide_tpu.testing.chaos import (
+            corrupt_checkpoint,
+        )
+        for f in self.chaos.take_serve(tick):
+            if f.kind == "serve_step_exception":
+                self._injected_exc += 1
+            elif f.kind == "client_abandon":
+                rid = self._abandon_target(int(f.param))
+                if rid is not None:
+                    self.cancel(rid)
+            elif f.kind == "arrival_burst":
+                if self.burst_factory is None:
+                    raise ValueError(
+                        "arrival_burst fault needs "
+                        "ServeEngine(burst_factory=...)")
+                for req in self.burst_factory(int(f.param), now):
+                    try:
+                        self.submit(req)
+                    except EngineOverloaded:
+                        pass  # the gate shedding the burst IS the scenario
+            elif f.kind == "pool_pressure":
+                self._grab_pressure(tick, int(f.param))
+            else:  # snapshot_truncate / snapshot_corrupt
+                if self.snapshot_dir is None:
+                    raise ValueError(
+                        f"{f.kind} fault needs ServeEngine("
+                        "snapshot_dir=...)")
+                if self._ckpt is not None:
+                    self._ckpt.wait()  # commit pending async saves first
+                try:
+                    corrupt_checkpoint(
+                        self.snapshot_dir,
+                        mode=("truncate" if f.kind == "snapshot_truncate"
+                              else "flip"))
+                except FileNotFoundError:
+                    pass  # no committed snapshot yet — nothing to damage
+
+    def _abandon_target(self, idx: int) -> int | None:
+        live = sorted(
+            {s.rid for s in self.sched.slots if s is not None}
+            | {r.rid for r in self.sched.queue})
+        if not live:
+            return None
+        return live[idx % len(live)]
+
+    def _grab_pressure(self, tick: int, nblocks: int) -> None:
+        # a co-tenant spike: blocks vanish from the pool for a few ticks
+        # under the reserved chaos owner, forcing eviction/re-prefill on
+        # residents — released by _release_pressure (or at run() exit)
+        pool = self.sched.pool
+        n = min(nblocks, pool.free_blocks)
+        if n <= 0:
+            return
+        blocks = pool.alloc(_CHAOS_RID, n)
+        if blocks:
+            self._pressure_holds.append(
+                (tick + _PRESSURE_HOLD_TICKS, blocks))
+
+    def _release_pressure(self, tick: float) -> None:
+        keep = []
+        for release_at, blocks in self._pressure_holds:
+            if tick >= release_at:
+                self.sched.pool.free(_CHAOS_RID, blocks)
+            else:
+                keep.append((release_at, blocks))
+        self._pressure_holds = keep
 
     # ---- drain -----------------------------------------------------------
 
@@ -275,11 +488,14 @@ class ServeEngine:
             evs, kind = self.step(now=float("inf"))
             events.extend(evs)
             if kind == "idle":
+                if self._pressure_holds:
+                    continue  # chaos holds blocks; they release by tick
                 raise RuntimeError(
                     "engine deadlock: work queued but nothing schedulable")
             ticks += 1
             if max_ticks is not None and ticks >= max_ticks:
                 break
+        self._release_pressure(float("inf"))
         return events
 
     def completions(self) -> dict[int, list[int]]:
@@ -291,6 +507,82 @@ class ServeEngine:
         """Blocks currently owned by resident requests — what the paged
         byte model charges a decode step for (vs. max_len always)."""
         return self.sched.pool.live_blocks()
+
+    def health(self) -> dict:
+        """Engine health counters — what the CLI/examples surface so a
+        degraded engine is observable, not silent."""
+        sd = self.sched
+        return {
+            "resident": sum(s is not None for s in sd.slots),
+            "queued": len(sd.queue),
+            "completed": len(sd.done),
+            "shed": sd.shed,
+            "cancelled": sd.cancelled,
+            "expired": sd.expired,
+            "preemptions": sd.preemptions,
+            "live_blocks": sd.pool.live_blocks(),
+            "last_tick_s": self.last_tick_s,
+            "ticks": self._tick,
+        }
+
+    # ---- snapshot / restore ----------------------------------------------
+
+    def save_snapshot(self, *, async_: bool = False) -> int | None:
+        """Serialize ALL host-side serving state (the scheduler's
+        continuation view of every live request, emitted tokens,
+        terminal statuses, counters) through PR 5's manifested /
+        CRC-verified checkpoint path. One uint8 blob: the state is a
+        dynamic Python structure, so it rides as JSON bytes and the
+        manifest's size+CRC checks cover it (``snapshot_truncate`` /
+        ``snapshot_corrupt`` are both caught at restore). The device
+        pool is NOT saved — restore re-prefills residents from their
+        recorded positions, which PR 10's position-derived keys make
+        bitwise-safe. Returns the snapshot label, or None if the save
+        was skipped."""
+        if self._ckpt is None:
+            raise ValueError("ServeEngine(snapshot_dir=...) not configured")
+        state = {"sched": self.sched.snapshot_state(),
+                 "tick": self._tick,
+                 "steps": dict(self.steps)}
+        blob = np.frombuffer(json.dumps(state).encode("utf-8"),
+                             dtype=np.uint8).copy()
+        label = max(self._tick, self._last_snap + 1)
+        if not self._ckpt.save(label, {"blob": blob}, force=True,
+                               async_=async_):
+            return None
+        self._last_snap = label
+        return label
+
+    def restore_latest_snapshot(self) -> int | None:
+        """Restore the newest VALID snapshot (the PR-5 ladder: a
+        truncated or CRC-corrupt snapshot is skipped, falling back to
+        the next older one) into THIS engine, which must be fresh. The
+        pool stays zeroed; every formerly-resident request re-enters as
+        a queued continuation and re-prefills through normal admission,
+        so each stream continues bitwise identical to an uninterrupted
+        run. Returns the restored label, or None when no valid snapshot
+        exists."""
+        if self._ckpt is None:
+            raise ValueError("ServeEngine(snapshot_dir=...) not configured")
+        got = self._ckpt.restore_latest_valid(None)
+        if got is None:
+            return None
+        tree, label = got
+        state = json.loads(
+            np.asarray(tree["blob"], np.uint8).tobytes().decode("utf-8"))
+        self.sched.restore_state(state["sched"])
+        self._tick = int(state["tick"])
+        for k, v in state["steps"].items():
+            self.steps[k] = int(v)
+        self._last_snap = label
+        return label
+
+    def close(self) -> None:
+        """Release background resources (watchdog thread, checkpointer)."""
+        if self._watchdog is not None:
+            self._watchdog.close()
+        if self._ckpt is not None:
+            self._ckpt.close()
 
 
 # ---- program contracts (analysis/) ------------------------------------------

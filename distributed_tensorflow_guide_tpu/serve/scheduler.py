@@ -19,6 +19,17 @@ regenerated stream bitwise the one it would have produced uninterrupted
 — eviction is free of replay divergence by construction). The
 scheduler-determinism test replays a seeded arrival trace twice and
 pins identical event logs.
+
+Request lifecycle (PR 11) also lives here: per-request TTFT/total
+deadlines and client cancellation are applied by :meth:`Scheduler.sweep`
+at step boundaries ONLY — a launched program is never torn down mid
+-step, so the pool ledger stays leak-free (``check_leaks`` clean) by
+construction.  Overload is refused at ``submit`` (queue-depth gate →
+:class:`EngineOverloaded`, a retriable rejection) instead of degrading
+resident streams.  :meth:`snapshot_state`/:meth:`restore_state`
+serialize every live request as a *continuation* — the exact transform
+``_preempt`` applies — which is why engine restore re-prefills and
+still lands on the same streams bitwise.
 """
 
 from __future__ import annotations
@@ -35,19 +46,36 @@ from distributed_tensorflow_guide_tpu.serve.paged_cache import (
 PREFILL, DECODE = "prefill", "decode"
 
 
+class EngineOverloaded(RuntimeError):
+    """Admission refused under overload — RETRIABLE by contract: nothing
+    about the request was recorded, so re-submitting the identical
+    request later yields the identical stream. Shedding at the door is
+    what keeps resident streams inside their SLOs instead of degrading
+    everyone a little."""
+
+    retriable = True
+
+
 @dataclasses.dataclass
 class Request:
     """One serving request. ``rng`` is the request's own PRNG key (raw
     (2,) uint32, what ``jax.random.PRNGKey`` returns) — sampling keys
     derive from (rng, absolute position), which is what makes the
     engine's per-request stream bitwise a one-shot
-    ``make_generate_fn(...)​(params, prompt[None], rng)`` run."""
+    ``make_generate_fn(...)​(params, prompt[None], rng)`` run.
+
+    ``ttft_deadline_s``/``deadline_s`` are optional budgets measured
+    from ``arrival``: breach terminates the request with status
+    ``"expired"`` at the next step boundary (TTFT applies only until
+    the first token; total always). ``None`` = no deadline."""
 
     rid: int
     prompt: np.ndarray  # (P,) int32
     max_new_tokens: int
     rng: np.ndarray  # (2,) uint32
     arrival: float = 0.0
+    ttft_deadline_s: float | None = None
+    deadline_s: float | None = None
 
 
 @dataclasses.dataclass
@@ -69,7 +97,8 @@ class Scheduler:
     """Slots + pool + queue; the engine asks it what to run each tick."""
 
     def __init__(self, *, slots: int, num_blocks: int, block_size: int,
-                 prefill_chunk: int, max_len: int) -> None:
+                 prefill_chunk: int, max_len: int,
+                 max_queue: int | None = None) -> None:
         if max_len % prefill_chunk:
             raise ValueError(
                 f"prefill_chunk {prefill_chunk} must divide max_len "
@@ -90,6 +119,14 @@ class Scheduler:
         self._seq = 0  # admission counter (preemption picks the youngest)
         self._prefer_prefill = True  # interleave chunked prefill w/ decode
         self.preemptions = 0
+        # lifecycle (PR 11): terminal statuses, deadlines, overload gate
+        self.max_queue = max_queue  # submit sheds past this queue depth
+        self.meta: dict[int, tuple[float, float | None, float | None]] = {}
+        self.finished: dict[int, str] = {}  # rid -> done|cancelled|expired
+        self._cancel_pending: set[int] = set()
+        self.shed = 0
+        self.cancelled = 0
+        self.expired = 0
 
     # ---- intake ----------------------------------------------------------
 
@@ -112,9 +149,21 @@ class Scheduler:
                 f"request {req.rid} can never fit: needs "
                 f"{self.max_request_blocks(P, req.max_new_tokens)} blocks, "
                 f"pool capacity {self.pool.capacity}")
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            self.shed += 1
+            raise EngineOverloaded(
+                f"request {req.rid} shed: queue depth {len(self.queue)} at "
+                f"the max_queue={self.max_queue} gate — retry later "
+                "(nothing was recorded; the retried stream is identical)")
         self.queue.append(req)
         self.emitted.setdefault(req.rid, [])
         self.first_emit.setdefault(req.rid, False)
+        # the request's lifecycle clock: original arrival + deadlines.
+        # Continuations re-enter via queue.insert (not submit), so this
+        # records exactly once per rid and deadline checks always measure
+        # from the ORIGINAL arrival, never a preemption re-queue.
+        self.meta.setdefault(req.rid, (float(req.arrival),
+                                       req.ttft_deadline_s, req.deadline_s))
 
     # ---- admission -------------------------------------------------------
 
@@ -198,6 +247,15 @@ class Scheduler:
         return [i for i in ready if self.slots[i] is not None]
 
     def _pick_victim(self, exclude: int) -> int | None:
+        """Deterministic victim choice, pinned by the _pick_victim test:
+        the YOUNGEST resident by admission order (highest
+        ``admitted_seq``) is evicted first — the request that has
+        received the least service loses its residency, which bounds
+        re-prefill waste and can never starve the head-of-line request.
+        ``admitted_seq`` is unique (one counter, bumped per admission),
+        so the max is total and two seeded runs can never diverge here —
+        this ordering is also the restore path's anchor:
+        ``snapshot_state`` writes residents in admission order."""
         live = [(s.admitted_seq, i) for i, s in enumerate(self.slots)
                 if s is not None and i != exclude and s.blocks]
         if not live:
@@ -265,7 +323,158 @@ class Scheduler:
             self.pool.free(rid, s.blocks)
             self.slots[slot_idx] = None
             self.done.add(rid)
+            self.finished[rid] = "done"
         return [(rid, token, first, done)]
+
+    # ---- lifecycle: cancellation, deadlines (PR 11) ----------------------
+
+    def cancel(self, rid: int) -> bool:
+        """Client cancellation — honored at the NEXT step boundary (the
+        sweep), never mid-launch, so the in-flight program completes and
+        the ledger stays clean. Returns False for unknown/terminal rids
+        (cancelling twice, or after completion, is a no-op)."""
+        known = rid in self.emitted and rid not in self.finished
+        if known:
+            self._cancel_pending.add(rid)
+        return known
+
+    def _terminal_status(self, rid: int, now: float) -> str | None:
+        if rid in self._cancel_pending:
+            return "cancelled"
+        arrival, ttft_dl, total_dl = self.meta.get(rid, (0.0, None, None))
+        if total_dl is not None and now - arrival > total_dl:
+            return "expired"
+        if (ttft_dl is not None and not self.first_emit.get(rid, False)
+                and now - arrival > ttft_dl):
+            return "expired"
+        return None
+
+    def sweep(self, now: float) -> list[tuple]:
+        """Step-boundary lifecycle sweep: pending cancellations and
+        deadline breaches terminate requests HERE. Resident victims free
+        their slot and blocks immediately (``check_leaks`` clean); queued
+        victims (including preempted continuations — their clock is the
+        ORIGINAL arrival in ``meta``) just leave the queue. Emits one
+        terminal pseudo-event ``(rid, -1, False, True, status)`` per
+        casualty; the already-emitted tokens remain in ``emitted`` as a
+        bitwise prefix of the uninterrupted stream."""
+        out = []
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            status = self._terminal_status(s.rid, now)
+            if status:
+                self.pool.free(s.rid, s.blocks)
+                self.slots[i] = None
+                out.append(self._finish(s.rid, status))
+        if self.queue:
+            keep = []
+            for req in self.queue:
+                status = self._terminal_status(req.rid, now)
+                if status is None:
+                    keep.append(req)
+                elif req.rid not in self.finished:
+                    out.append(self._finish(req.rid, status))
+            self.queue = keep
+        self._cancel_pending.clear()
+        return out
+
+    def _finish(self, rid: int, status: str) -> tuple:
+        self.finished[rid] = status
+        if status == "cancelled":
+            self.cancelled += 1
+        else:
+            self.expired += 1
+        return (rid, -1, False, True, status)
+
+    # ---- snapshot / restore (PR 11) --------------------------------------
+
+    def snapshot_state(self) -> dict:
+        """JSON-serializable host state for the engine snapshot: every
+        resident as a CONTINUATION (the ``_preempt`` transform — prompt
+        plus emitted tail, remaining budget, same rng), residents first
+        in admission order then the queue in order; plus the emitted /
+        terminal maps and counters. The block pool and device cache are
+        deliberately NOT captured — restore re-prefills each
+        continuation, and position-derived sampling keys make the re-run
+        land on the same stream bitwise."""
+        requests = []
+        live = sorted((s for s in self.slots if s is not None),
+                      key=lambda s: s.admitted_seq)
+        for s in live:
+            prompt = s.prompt
+            if s.emitted_here:
+                tail = self.emitted[s.rid][-s.emitted_here:]
+                prompt = np.concatenate(
+                    [s.prompt, np.asarray(tail, np.int32)])
+            requests.append({
+                "rid": int(s.rid),
+                "prompt": [int(t) for t in prompt],
+                "budget": int(s.budget),
+                "rng": [int(x) for x in np.asarray(s.rng).ravel()],
+                "arrival": float("-inf"),  # already served once
+            })
+        for r in self.queue:
+            requests.append({
+                "rid": int(r.rid),
+                "prompt": [int(t) for t in np.asarray(r.prompt)],
+                "budget": int(r.max_new_tokens),
+                "rng": [int(x) for x in np.asarray(r.rng).ravel()],
+                "arrival": float(r.arrival),
+            })
+        return {
+            "requests": requests,
+            "emitted": {str(k): [int(t) for t in v]
+                        for k, v in self.emitted.items()},
+            "first_emit": sorted(
+                int(k) for k, v in self.first_emit.items() if v),
+            "done": sorted(int(r) for r in self.done),
+            "finished": {str(k): v for k, v in self.finished.items()},
+            "meta": {str(k): [v[0], v[1], v[2]]
+                     for k, v in self.meta.items()},
+            "counters": {"seq": self._seq,
+                         "preemptions": self.preemptions,
+                         "shed": self.shed,
+                         "cancelled": self.cancelled,
+                         "expired": self.expired},
+        }
+
+    def restore_state(self, snap: dict) -> None:
+        """Rebuild from :meth:`snapshot_state` output onto a FRESH
+        scheduler (no residents, empty queue — the restoring engine owns
+        a zeroed pool). Every snapshotted request re-enters as a queued
+        continuation and re-prefills through normal admission."""
+        if self.has_resident or self.queue:
+            raise RuntimeError(
+                "restore_state needs a fresh scheduler (residents or "
+                "queue present)")
+        self.queue = [
+            Request(rid=int(r["rid"]),
+                    prompt=np.asarray(r["prompt"], np.int32),
+                    max_new_tokens=int(r["budget"]),
+                    rng=np.asarray(r["rng"], np.uint32),
+                    arrival=float(r["arrival"]))
+            for r in snap["requests"]
+        ]
+        self.emitted = {int(k): [int(t) for t in v]
+                        for k, v in snap["emitted"].items()}
+        self.first_emit = {rid: False for rid in self.emitted}
+        for rid in snap["first_emit"]:
+            self.first_emit[int(rid)] = True
+        self.done = {int(r) for r in snap["done"]}
+        self.finished = {int(k): v for k, v in snap["finished"].items()}
+        self.meta = {
+            int(k): (float(v[0]),
+                     None if v[1] is None else float(v[1]),
+                     None if v[2] is None else float(v[2]))
+            for k, v in snap["meta"].items()
+        }
+        c = snap["counters"]
+        self._seq = int(c["seq"])
+        self.preemptions = int(c["preemptions"])
+        self.shed = int(c["shed"])
+        self.cancelled = int(c["cancelled"])
+        self.expired = int(c["expired"])
 
     # ---- introspection ---------------------------------------------------
 

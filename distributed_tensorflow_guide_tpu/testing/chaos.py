@@ -38,6 +38,33 @@ supervisor (train/elastic_world.py) rather than the step/data injectors:
                     the supervisor regrows to full world at that boundary
 ==================  =========================================================
 
+Serve-kind faults target the continuous-batching engine
+(serve/engine.py) and fire at deterministic engine *tick* positions —
+the engine drains them via :meth:`FaultSchedule.take_serve` at the top
+of each ``step`` call.  Like the world kinds they are excluded from
+:meth:`FaultSchedule.random`'s default draw (the training-side
+injectors would silently swallow them and existing storms would hang
+waiting); draw a serving storm with :meth:`random_serve` instead:
+
+=======================  ====================================================
+``serve_step_exception``  the next jitted program launch raises (a transient
+                          launch failure); the engine's retry re-runs the
+                          SAME tick bitwise
+``client_abandon``        a live request is cancelled (``param`` indexes the
+                          sorted live rids); slot+blocks free at the next
+                          step boundary
+``arrival_burst``         ``param`` extra requests arrive at once through the
+                          engine's ``burst_factory`` — the admission gate's
+                          prey
+``pool_pressure``         ``param`` KV blocks vanish from the pool for a few
+                          ticks (a co-tenant spike); residents get evicted
+                          and must re-prefill
+``snapshot_truncate``     the newest committed engine snapshot is truncated
+                          (caught by the manifest size check)
+``snapshot_corrupt``      same, but bytes flip in place (only the CRC
+                          catches it) — the restore ladder falls back
+=======================  ====================================================
+
 Mid-save process kills are process-level, not stream-level: use
 ``runtime.multiprocess.MultiProcessRunner.kill`` directly (see the chaos
 tests). Every fault is one-shot — after it fires once it never fires again,
@@ -66,7 +93,15 @@ INJECTABLE_KINDS = STEP_KINDS + DATA_KINDS
 # world kinds change job capacity; they are applied by the elastic
 # supervisor (train/elastic_world.py), which marks them fired via fire()
 WORLD_KINDS = ("slice_loss", "slice_return")
-KINDS = INJECTABLE_KINDS + WORLD_KINDS
+# serve storm kinds fire inside ServeEngine.step at engine-tick
+# positions; the snapshot kinds additionally need an engine snapshot
+# directory to damage, so random_serve leaves them out of its default
+# draw the same way random() leaves out the ckpt-less-safe split
+SERVE_STORM_KINDS = ("serve_step_exception", "client_abandon",
+                     "arrival_burst", "pool_pressure")
+SERVE_SNAPSHOT_KINDS = ("snapshot_truncate", "snapshot_corrupt")
+SERVE_KINDS = SERVE_STORM_KINDS + SERVE_SNAPSHOT_KINDS
+KINDS = INJECTABLE_KINDS + WORLD_KINDS + SERVE_KINDS
 
 
 class ChaosInjectedError(RuntimeError):
@@ -94,6 +129,17 @@ class Fault:
                 raise ValueError(
                     f"{self.kind} needs param = a non-negative slice "
                     f"index, got {self.param!r}")
+        if self.kind == "client_abandon":
+            # param indexes the engine's sorted live rids (mod count)
+            if self.param != int(self.param) or self.param < 0:
+                raise ValueError(
+                    f"client_abandon needs param = a non-negative live-rid "
+                    f"index, got {self.param!r}")
+        if self.kind in ("arrival_burst", "pool_pressure"):
+            if self.param != int(self.param) or self.param < 1:
+                raise ValueError(
+                    f"{self.kind} needs param = a positive count "
+                    f"(requests / blocks), got {self.param!r}")
 
     @property
     def slice_id(self) -> int:
@@ -230,6 +276,45 @@ class FaultSchedule:
             Fault("slice_return", return_at, float(target)),
         ])
 
+    @classmethod
+    def random_serve(cls, seed: int, *, max_position: int,
+                     kinds: Sequence[str] = SERVE_STORM_KINDS,
+                     n_faults: int = 4, min_position: int = 1,
+                     burst_n: int = 2, pressure_blocks: int = 4,
+                     abandon_span: int = 4) -> "FaultSchedule":
+        """Deterministic-in-``seed`` serving storm: ``n_faults`` distinct
+        engine-tick positions in ``[min_position, max_position)``, kinds
+        drawn uniformly from ``kinds`` (defaults to the storm kinds — the
+        snapshot kinds need ``ServeEngine(snapshot_dir=...)``, so pass
+        ``SERVE_KINDS`` explicitly to include them). Params: bursts are
+        ``burst_n`` requests, pressure spikes grab ``pressure_blocks``,
+        abandons index the live rids in ``[0, abandon_span)``. Same seed
+        → identical schedule, always."""
+        bad = [k for k in kinds if k not in SERVE_KINDS]
+        if bad:
+            raise ValueError(f"non-serve kinds in random_serve: {bad}")
+        if max_position - min_position < n_faults:
+            raise ValueError(
+                f"cannot place {n_faults} faults in "
+                f"[{min_position}, {max_position})")
+        rng = np.random.RandomState(seed)
+        positions = rng.choice(
+            np.arange(min_position, max_position), size=n_faults,
+            replace=False,
+        )
+        chosen = rng.choice(len(kinds), size=n_faults)
+        params = {"serve_step_exception": lambda: 0.0,
+                  "snapshot_truncate": lambda: 0.0,
+                  "snapshot_corrupt": lambda: 0.0,
+                  "client_abandon": lambda: float(
+                      rng.randint(0, abandon_span)),
+                  "arrival_burst": lambda: float(burst_n),
+                  "pool_pressure": lambda: float(pressure_blocks)}
+        return cls([
+            Fault(kinds[int(k)], int(p), params[kinds[int(k)]]())
+            for p, k in zip(positions, chosen)
+        ])
+
     @property
     def pending(self) -> list[Fault]:
         return sorted(self._pending, key=lambda f: (f.position, f.kind))
@@ -238,6 +323,18 @@ class FaultSchedule:
         """Pending world-kind faults, soonest first — the elastic
         supervisor's work queue."""
         return [f for f in self.pending if f.kind in WORLD_KINDS]
+
+    def serve_events(self) -> list[Fault]:
+        """Pending serve-kind faults, soonest first — what the engine has
+        yet to absorb (tests assert this drains to [] at run end)."""
+        return [f for f in self.pending if f.kind in SERVE_KINDS]
+
+    def take_serve(self, tick: int) -> list[Fault]:
+        """Consume (one-shot) the serve-kind faults due at engine tick
+        ``tick``. The engine calls this at the top of every ``step`` and
+        applies what comes back — the mechanism lives in the engine, the
+        schedule only decides *when*, mirroring the world-kind split."""
+        return self._take(tick, SERVE_KINDS)
 
     def fire(self, fault: Fault) -> None:
         """Mark an externally-applied fault fired (one-shot bookkeeping

@@ -269,6 +269,10 @@ class Checkpointer:
 
         ``state_like`` may be a concrete state (its values are discarded) or
         a tree of jax.ShapeDtypeStruct with shardings attached.
+        ``state_like=None`` restores AS-SAVED (no abstract target): the
+        escape hatch for checkpoints whose tree structure is data-dependent
+        — the serving engine's snapshot blob rides this path, since its
+        shape isn't knowable before reading it back.
 
         ``layout``: the restoring model's layout-identity dict; compared
         against the sidecar written at save time (see :meth:`save`) and
@@ -294,6 +298,9 @@ class Checkpointer:
                         "tree shapes do NOT imply the same layer order "
                         "(e.g. interleaved virtual-chunk stacks)"
                     )
+        if state_like is None:
+            return self._mngr.restore(step,
+                                      args=ocp.args.StandardRestore())
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
         return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
 

@@ -196,6 +196,13 @@ def main() -> None:
     oracle = one[0, len(encoded[0]):].tolist()
     assert eng.completions()[0] == oracle, "engine/one-shot divergence"
     print("engine == one-shot for req 0: ok")
+
+    # shutdown contract (PR 11): health counters and a loud block-ledger
+    # audit — every pool block accounted for before the engine goes away
+    print(f"health: {eng.health()}")
+    eng.sched.pool.check_leaks()
+    eng.close()
+    print("pool.check_leaks(): clean")
     print("serve ok")
 
 

@@ -334,13 +334,85 @@ def test_random_world_deterministic_and_ordered():
 
 def test_random_default_draw_stays_injectable():
     """random()'s default kinds must remain the in-process injectable five
-    — a world kind in a storm schedule would never fire through
+    — a world OR serve kind in a storm schedule would never fire through
     wrap_step/inject_data and the storm pin would hang on it."""
     from distributed_tensorflow_guide_tpu.testing.chaos import (
-        INJECTABLE_KINDS, WORLD_KINDS,
+        INJECTABLE_KINDS, SERVE_KINDS, WORLD_KINDS,
     )
 
     for seed in range(8):
         sched = FaultSchedule.random(seed, max_position=40, n_faults=5)
         assert all(f.kind in INJECTABLE_KINDS for f in sched.faults)
         assert not any(f.kind in WORLD_KINDS for f in sched.faults)
+        assert not any(f.kind in SERVE_KINDS for f in sched.faults)
+
+
+# ---- serve fault kinds (PR 11) ----------------------------------------------
+# serve kinds fire inside ServeEngine.step via take_serve() — the schedule
+# does the seeded planning + one-shot bookkeeping, pinned here; the
+# engine-side crash-equivalence pins (storm invisibility, deadline/cancel
+# lifecycle, snapshot/restore bitwise) live in tests/test_serving.py.
+
+
+def test_serve_kinds_validate_params():
+    Fault("serve_step_exception", 3)  # param-free
+    assert Fault("client_abandon", 3, 2.0).param == 2.0
+    with pytest.raises(ValueError, match="live-rid"):
+        Fault("client_abandon", 3, -1.0)
+    with pytest.raises(ValueError, match="live-rid"):
+        Fault("client_abandon", 3, 1.5)  # fractional index
+    with pytest.raises(ValueError, match="positive count"):
+        Fault("arrival_burst", 3)  # needs how many requests
+    with pytest.raises(ValueError, match="positive count"):
+        Fault("pool_pressure", 3, 0.5)  # fractional block count
+
+
+def test_random_serve_deterministic_and_storm_only_by_default():
+    from distributed_tensorflow_guide_tpu.testing.chaos import (
+        SERVE_KINDS, SERVE_SNAPSHOT_KINDS, SERVE_STORM_KINDS,
+    )
+
+    a = FaultSchedule.random_serve(5, max_position=40)
+    b = FaultSchedule.random_serve(5, max_position=40)
+    assert a.faults == b.faults
+    c = FaultSchedule.random_serve(6, max_position=40)
+    assert a.faults != c.faults
+    for seed in range(8):
+        s = FaultSchedule.random_serve(seed, max_position=40)
+        # the default draw is storm kinds only: snapshot kinds need
+        # ServeEngine(snapshot_dir=...) and must be opted into
+        assert all(f.kind in SERVE_STORM_KINDS for f in s.faults)
+        assert not any(f.kind in SERVE_SNAPSHOT_KINDS for f in s.faults)
+    # opting in works; opting in a non-serve kind is rejected loudly
+    s = FaultSchedule.random_serve(0, max_position=40, kinds=SERVE_KINDS)
+    assert all(f.kind in SERVE_KINDS for f in s.faults)
+    with pytest.raises(ValueError, match="non-serve"):
+        FaultSchedule.random_serve(0, max_position=40,
+                                   kinds=("step_exception",))
+
+
+def test_take_serve_is_one_shot_and_position_targeted():
+    f2 = Fault("serve_step_exception", 2)
+    f5 = Fault("pool_pressure", 5, 4.0)
+    sched = FaultSchedule([f2, f5, Fault("step_exception", 2)])
+    assert sched.serve_events() == [f2, f5]
+    assert sched.take_serve(0) == []
+    assert sched.take_serve(2) == [f2]
+    assert sched.take_serve(2) == []  # one-shot
+    assert sched.serve_events() == [f5]
+    # the co-positioned train-side fault is NOT consumed by the engine
+    assert any(f.kind == "step_exception" for f in sched.pending)
+
+
+def test_injectors_never_consume_serve_kinds(tmp_path):
+    """wrap_step/inject_data must pass serve faults by: their mechanism
+    is ServeEngine.step, and silently consuming them would erase a
+    scheduled serving fault (the world-kind rule, serving flavour)."""
+    sched = FaultSchedule([Fault("serve_step_exception", 0),
+                           Fault("client_abandon", 1, 0.0)])
+    step = sched.wrap_step(_step_fn)
+    state, batch = _init(), jnp.zeros((4,))
+    data = sched.inject_data(_make_data, checkpoint_dir=tmp_path)(0)
+    for _ in range(3):
+        state, _ = step(state, next(data))
+    assert len(sched.serve_events()) == 2 and not sched.fired

@@ -8,6 +8,8 @@ deterministic scheduling under a fixed trace, and the paged byte model.
 """
 
 import dataclasses
+import time
+from types import SimpleNamespace
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +30,7 @@ from distributed_tensorflow_guide_tpu.ops.decode_attention import (
 )
 from distributed_tensorflow_guide_tpu.serve import (
     BlockPool,
+    EngineOverloaded,
     Request,
     ServeEngine,
     blocks_for,
@@ -35,6 +38,11 @@ from distributed_tensorflow_guide_tpu.serve import (
     gather_view,
     scatter_chunk,
     table_row,
+)
+from distributed_tensorflow_guide_tpu.serve.scheduler import Scheduler, _Slot
+from distributed_tensorflow_guide_tpu.testing.chaos import (
+    Fault,
+    FaultSchedule,
 )
 
 CFG = TransformerConfig(vocab_size=64, num_layers=2, num_heads=2,
@@ -53,14 +61,27 @@ def params():
         jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))["params"]
 
 
+_ORACLE_CACHE: dict = {}  # every make_generate_fn call is a fresh compile
+
+
 def _oracle(cfg, params, i, temp, top_k, *, prompts=PROMPTS,
             max_new=MAX_NEW, **gen_kw):
-    """The one-shot stream request ``i`` must reproduce bitwise."""
+    """The one-shot stream request ``i`` must reproduce bitwise.
+
+    Memoized: many tests pin against the same (cfg, request, sampling)
+    oracle, and each uncached call compiles a whole one-shot program —
+    the cache is most of this file's tier-1 wall-clock budget. Safe
+    because every caller passes the module-scoped ``params`` fixture.
+    """
     p, mn = prompts[i], max_new[i]
-    gen = make_generate_fn(cfg, max_new_tokens=mn, temperature=temp,
-                           top_k=top_k, **gen_kw)
-    out = gen(params, p[None], jax.random.PRNGKey(100 + i))
-    return np.asarray(out)[0, len(p):].tolist()
+    key = (repr(cfg), i, temp, top_k, tuple(p.tolist()), mn,
+           tuple(sorted(gen_kw.items())))
+    if key not in _ORACLE_CACHE:
+        gen = make_generate_fn(cfg, max_new_tokens=mn, temperature=temp,
+                               top_k=top_k, **gen_kw)
+        out = gen(params, p[None], jax.random.PRNGKey(100 + i))
+        _ORACLE_CACHE[key] = np.asarray(out)[0, len(p):].tolist()
+    return list(_ORACLE_CACHE[key])
 
 
 def _serve(cfg, params, *, temp, top_k, prompts=PROMPTS, max_new=MAX_NEW,
@@ -340,3 +361,437 @@ def test_step_fns_donation_declared_and_gated():
                           prefill_chunk=8) is not fns
     assert build_step_fns(CFG, slots=2, num_blocks=9, block_size=8,
                           prefill_chunk=8, temperature=0.5) is not fns
+
+
+# ---- serving under fire (PR 11) ---------------------------------------------
+# Request lifecycle (cancel / deadlines / shedding), chaos absorption, and
+# engine snapshot/restore. Everything here reuses the geometries the tests
+# above already compiled (the build_step_fns memo), so this whole section
+# adds no new program compiles to tier-1.
+
+
+def _submit_all(eng, *, prompts=PROMPTS, max_new=MAX_NEW):
+    for i, (p, mn) in enumerate(zip(prompts, max_new)):
+        eng.submit(Request(rid=i, prompt=p, max_new_tokens=mn,
+                           rng=jax.random.PRNGKey(100 + i)))
+
+
+def test_pick_victim_is_youngest_admission_deterministically(params):
+    """The documented tie-break: the victim is the YOUNGEST resident by
+    admission order (highest admitted_seq — unique per admission, so the
+    max is total and replay can never diverge), excluding the growing
+    slot and blockless residents."""
+    sch = Scheduler(slots=3, num_blocks=9, block_size=8, prefill_chunk=8,
+                    max_len=64)
+    key = np.asarray(jax.random.PRNGKey(0))
+
+    def mk(rid, seq, blocks):
+        return _Slot(rid=rid, prompt=np.array([1], np.int32), budget=4,
+                     rng=key, blocks=blocks, admitted_seq=seq)
+
+    sch.slots = [mk(0, 5, [0]), mk(1, 9, [1]), mk(2, 7, [2])]
+    assert sch._pick_victim(exclude=0) == 1  # seq 9 is youngest
+    assert sch._pick_victim(exclude=1) == 2  # excluding it: seq 7
+    sch.slots[1] = None
+    assert sch._pick_victim(exclude=0) == 2
+    sch.slots[2].blocks = []  # blockless: evicting frees nothing
+    assert sch._pick_victim(exclude=0) is None
+
+    # end to end: under forced eviction the victim SEQUENCE is a pure
+    # function of the submitted trace — two runs preempt identical rids
+    # in identical order
+    prompts = [np.array([3, 5, 7, 9, 11], np.int32),
+               np.array([2, 4, 6, 8, 10, 12, 14], np.int32)]
+
+    def victims_once():
+        eng = ServeEngine(CFG, params, slots=2, num_blocks=9,
+                          block_size=8, prefill_chunk=8, temperature=0.7,
+                          top_k=12)
+        victims = []
+        orig = eng.sched._preempt
+
+        def spy(i):
+            victims.append(eng.sched.slots[i].rid)
+            return orig(i)
+
+        eng.sched._preempt = spy
+        for i, p in enumerate(prompts):
+            eng.submit(Request(rid=i, prompt=p, max_new_tokens=40,
+                               rng=jax.random.PRNGKey(100 + i)))
+        eng.run()
+        return victims
+
+    v1, v2 = victims_once(), victims_once()
+    assert v1 and v1 == v2
+
+
+def test_cancel_frees_resources_and_preserves_prefix(params):
+    """Client cancellation mid-decode: one terminal event at the next
+    step boundary, slot+blocks freed (check_leaks clean), survivors
+    bitwise, and the cancelled stream is a bitwise PREFIX of its
+    uninterrupted one-shot run — cancellation never corrupts what was
+    already delivered."""
+    eng = ServeEngine(CFG, params, slots=2, num_blocks=33, block_size=8,
+                      prefill_chunk=8, temperature=0.8, top_k=10)
+    _submit_all(eng)
+    events = []
+    for _ in range(6):  # rid 0 is mid-decide: >=1 token, budget unspent
+        evs, _ = eng.step()
+        events.extend(evs)
+    assert eng.cancel(0) is True
+    assert eng.cancel(99) is False  # unknown rid: a no-op, not an error
+    events.extend(eng.run())
+    term = [e for e in events if e.rid == 0 and e.status == "cancelled"]
+    assert len(term) == 1 and term[0].token == -1 and term[0].done
+    assert eng.cancel(0) is False  # already terminal: a no-op
+    got = eng.completions()
+    for i in (1, 2):  # survivors: completely unaffected, bitwise
+        assert got[i] == _oracle(CFG, params, i, 0.8, 10), f"req {i}"
+    o0 = _oracle(CFG, params, 0, 0.8, 10)
+    assert 0 < len(got[0]) < len(o0) and got[0] == o0[:len(got[0])]
+    assert eng.sched.finished[0] == "cancelled"
+    assert eng.health()["cancelled"] == 1
+    eng.sched.pool.check_leaks()
+    assert eng.live_blocks() == 0
+
+
+def test_deadlines_expire_at_step_boundaries(params):
+    """TTFT and total deadlines, measured from the ORIGINAL arrival and
+    evaluated at step boundaries by the sweep. run()'s now=inf would
+    expire every deadline instantly — deadlines need a clock-driving
+    caller (docs/serving.md), so this test advances now explicitly."""
+    eng = ServeEngine(CFG, params, slots=2, num_blocks=33, block_size=8,
+                      prefill_chunk=8, temperature=0.0)
+    p0, p1, p2 = PROMPTS
+    eng.submit(Request(rid=0, prompt=p0, max_new_tokens=8,
+                       rng=jax.random.PRNGKey(100)))
+    # expires mid-decode: ~7 ticks of service at 0.01s/tick
+    eng.submit(Request(rid=1, prompt=p1, max_new_tokens=6,
+                       rng=jax.random.PRNGKey(101), deadline_s=0.075))
+    # both slots are busy, so this one waits queued; TTFT 0 expires it
+    # at the first swept boundary without it ever emitting
+    eng.submit(Request(rid=2, prompt=p2, max_new_tokens=10,
+                       rng=jax.random.PRNGKey(102), ttft_deadline_s=0.0))
+    now, events, ticks = 0.0, [], 0
+    while eng.sched.has_queued or eng.sched.has_resident:
+        evs, kind = eng.step(now)
+        events.extend(evs)
+        now += 0.01
+        ticks += 1
+        assert ticks < 200
+    statuses = {e.rid: e.status for e in events if e.token < 0}
+    assert statuses == {1: "expired", 2: "expired"}
+    got = eng.completions()
+    assert got[0] == _oracle(CFG, params, 0, 0.0, None)  # no deadline set
+    o1 = _oracle(CFG, params, 1, 0.0, None)
+    assert 0 < len(got[1]) < len(o1) and got[1] == o1[:len(got[1])]
+    assert got[2] == []  # expired while queued: zero tokens
+    assert eng.health()["expired"] == 2
+    eng.sched.pool.check_leaks()
+    # the predicted-TTFT gate is warm now (finite clock above): a request
+    # whose TTFT budget is already below recent TTFTs is shed at the door
+    assert eng._ttft_ewma is not None and eng._ttft_ewma > 0
+    with pytest.raises(EngineOverloaded, match="recent TTFT"):
+        eng.submit(Request(rid=7, prompt=p0, max_new_tokens=4,
+                           rng=jax.random.PRNGKey(7),
+                           ttft_deadline_s=eng._ttft_ewma / 2))
+    assert eng.health()["shed"] == 1
+
+
+def test_overload_sheds_retriably_at_the_door(params):
+    """Queue-depth admission control: past max_queue, submit raises the
+    retriable EngineOverloaded and records NOTHING — the identical
+    resubmission later yields the identical stream bitwise."""
+    eng = ServeEngine(CFG, params, slots=2, num_blocks=33, block_size=8,
+                      prefill_chunk=8, temperature=0.8, top_k=10,
+                      max_queue=2)
+    _submit_all(eng, prompts=PROMPTS[:2], max_new=MAX_NEW[:2])
+    with pytest.raises(EngineOverloaded, match="retry"):
+        eng.submit(Request(rid=2, prompt=PROMPTS[2],
+                           max_new_tokens=MAX_NEW[2],
+                           rng=jax.random.PRNGKey(102)))
+    assert EngineOverloaded.retriable is True
+    assert eng.sched.shed == 1 and 2 not in eng.sched.emitted
+    eng.run()
+    eng.submit(Request(rid=2, prompt=PROMPTS[2], max_new_tokens=MAX_NEW[2],
+                       rng=jax.random.PRNGKey(102)))
+    eng.run()
+    assert eng.completions()[2] == _oracle(CFG, params, 2, 0.8, 10)
+    assert eng.health()["shed"] == 1
+    eng.sched.pool.check_leaks()
+
+
+def test_step_exception_and_pool_pressure_storm_is_invisible(params):
+    """An injected launch failure retries the SAME tick bitwise; a pool
+    -pressure spike forces eviction/re-prefill. Neither may change a
+    single emitted token, leak a block, or leave a fault unabsorbed."""
+    sched = FaultSchedule([Fault("serve_step_exception", 2),
+                           Fault("pool_pressure", 4, 4.0)])
+    eng = ServeEngine(CFG, params, slots=2, num_blocks=33, block_size=8,
+                      prefill_chunk=8, temperature=0.8, top_k=10,
+                      chaos=sched, retry_base_delay_s=0.001)
+    _submit_all(eng)
+    eng.run()
+    got = eng.completions()
+    for i in range(len(PROMPTS)):
+        assert got[i] == _oracle(CFG, params, i, 0.8, 10), f"req {i}"
+    assert sched.serve_events() == [] and len(sched.fired) == 2
+    eng.sched.pool.check_leaks()
+    assert eng.live_blocks() == 0
+
+
+def test_arrival_burst_and_client_abandon(params):
+    """A burst-injected request streams to completion bitwise like any
+    other; a client_abandon fault cancels a live rid whose delivered
+    tokens stay a bitwise prefix. check_leaks clean throughout."""
+    def burst(n, now):
+        assert n == 1
+        return [Request(rid=1000, prompt=PROMPTS[0], max_new_tokens=4,
+                        rng=jax.random.PRNGKey(42), arrival=now)]
+
+    sched = FaultSchedule([Fault("arrival_burst", 3, 1.0),
+                           Fault("client_abandon", 6, 0.0)])
+    eng = ServeEngine(CFG, params, slots=2, num_blocks=33, block_size=8,
+                      prefill_chunk=8, temperature=0.8, top_k=10,
+                      chaos=sched, burst_factory=burst)
+    _submit_all(eng)
+    eng.run()
+    assert sched.serve_events() == [] and len(sched.fired) == 2
+    # the burst request == its own one-shot run, bitwise
+    gen = make_generate_fn(CFG, max_new_tokens=4, temperature=0.8,
+                           top_k=10)
+    out = gen(params, PROMPTS[0][None], jax.random.PRNGKey(42))
+    assert eng.completions()[1000] == \
+        np.asarray(out)[0, len(PROMPTS[0]):].tolist()
+    # abandon index 0 cancelled the lowest live rid (= 0, still serving)
+    cancelled = [r for r, st in eng.sched.finished.items()
+                 if st == "cancelled"]
+    assert cancelled == [0]
+    got0 = eng.completions()[0]
+    o0 = _oracle(CFG, params, 0, 0.8, 10)
+    assert got0 == o0[:len(got0)]
+    eng.sched.pool.check_leaks()
+    assert eng.live_blocks() == 0
+
+
+def test_watchdog_breaks_hung_step_and_retry_is_bitwise(params):
+    """A hung compiled step becomes WatchdogTimeout (not a silent stall)
+    and retries like any transient — the re-run tick is bitwise the
+    original. deadline=1.5s: the per-attempt deadline must cover a
+    first-launch XLA compile (~0.25s on CPU), the operational footgun
+    docs/serving.md calls out."""
+    eng = ServeEngine(CFG, params, slots=2, num_blocks=33, block_size=8,
+                      prefill_chunk=8, temperature=0.0,
+                      step_deadline_s=1.5, retry_base_delay_s=0.01)
+    # copy the memoized namespace before wrapping — mutating the shared
+    # one would poison every other engine at this geometry
+    eng.fns = SimpleNamespace(**vars(eng.fns))
+    real = eng.fns.decode
+    state = {"hung": False}
+
+    def hang_once(*a, **kw):
+        if not state["hung"]:
+            state["hung"] = True
+            end = time.monotonic() + 30.0
+            while time.monotonic() < end:  # interruptible: small slices
+                time.sleep(0.02)
+        return real(*a, **kw)
+
+    eng.fns.decode = hang_once
+    _submit_all(eng, prompts=PROMPTS[:2], max_new=MAX_NEW[:2])
+    t0 = time.perf_counter()
+    eng.run()
+    assert time.perf_counter() - t0 < 15.0  # the 30s hang was broken
+    assert state["hung"]
+    got = eng.completions()
+    for i in range(2):
+        assert got[i] == _oracle(CFG, params, i, 0.0, None), f"req {i}"
+    eng.sched.pool.check_leaks()
+    eng.close()
+
+
+def test_engine_kill_restore_resumes_bitwise(params, tmp_path):
+    """The tentpole pin: snapshot, keep serving, kill, restore a FRESH
+    engine from the snapshot — every in-flight stream continues and ends
+    bitwise identical to an uninterrupted run, and the span the kill
+    dropped is re-emitted bitwise (position-derived keys; the pool is
+    never saved, residents re-prefill as continuations)."""
+    kw = dict(slots=2, num_blocks=33, block_size=8, prefill_chunk=8,
+              temperature=0.8, top_k=10,
+              snapshot_dir=str(tmp_path / "snap"))
+    eng = ServeEngine(CFG, params, **kw)
+    _submit_all(eng)
+    for _ in range(7):
+        eng.step()
+    label = eng.save_snapshot()
+    assert label is not None
+    for _ in range(3):  # post-snapshot progress the restore must re-earn
+        eng.step()
+    pre = eng.completions()
+    assert any(pre.values())  # the kill really drops emitted tokens
+    eng.close()  # the "kill": nothing after the snapshot persists
+
+    eng2 = ServeEngine(CFG, params, **kw)
+    assert eng2.restore_latest_snapshot() == label
+    eng2.run()
+    got = eng2.completions()
+    for i in range(len(PROMPTS)):
+        assert got[i] == _oracle(CFG, params, i, 0.8, 10), f"req {i}"
+        # everything delivered pre-kill is a prefix of the final stream
+        assert pre[i] == got[i][:len(pre[i])]
+    eng2.sched.pool.check_leaks()
+    assert eng2.live_blocks() == 0
+    eng2.close()
+
+
+def test_snapshot_ladder_skips_corrupt_through_eviction(params, tmp_path):
+    """snapshot_corrupt damages the newest snapshot post-commit; restore
+    must ladder down to the previous valid one and STILL land every
+    stream bitwise — here through the forced-eviction geometry, so the
+    restore path composes with preemption/continuation."""
+    prompts = [np.array([3, 5, 7, 9, 11], np.int32),
+               np.array([2, 4, 6, 8, 10, 12, 14], np.int32)]
+    max_new = [40, 40]
+    sched = FaultSchedule([Fault("snapshot_corrupt", 24)])
+    kw = dict(slots=2, num_blocks=9, block_size=8, prefill_chunk=8,
+              temperature=0.7, top_k=12, snapshot_dir=str(tmp_path / "s"))
+    eng = ServeEngine(CFG, params, chaos=sched, **kw)
+    for i, (p, mn) in enumerate(zip(prompts, max_new)):
+        eng.submit(Request(rid=i, prompt=p, max_new_tokens=mn,
+                           rng=jax.random.PRNGKey(100 + i)))
+    for t in range(26):  # saves land at ticks 8, 16, 24; corrupt at 24
+        eng.step()
+        if (t + 1) % 8 == 0:
+            eng.save_snapshot()
+    assert sched.serve_events() == []  # the corruption really landed
+    eng.close()
+
+    eng2 = ServeEngine(CFG, params, **kw)
+    assert eng2.restore_latest_snapshot() == 16  # 24 is damaged: fall back
+    eng2.run()
+    got = eng2.completions()
+    for i in range(2):
+        assert got[i] == _oracle(CFG, params, i, 0.7, 12, prompts=prompts,
+                                 max_new=max_new), f"req {i}"
+    assert eng.sched.preemptions + eng2.sched.preemptions >= 1
+    eng2.sched.pool.check_leaks()
+    eng2.close()
+
+
+@pytest.mark.parametrize("kv,impl", [("int8", "dense"), (None, "pallas")])
+def test_snapshot_restore_across_decode_levers(params, kv, impl, tmp_path):
+    """Kill+restore composes with the decode levers: the restored
+    engine's re-prefilled continuations stay bitwise under int8 KV and
+    the paged Pallas read path too."""
+    cfg = dataclasses.replace(CFG, kv_dtype=kv, decode_impl=impl)
+    prompts, max_new = PROMPTS[:2], MAX_NEW[:2]
+    kw = dict(slots=2, num_blocks=17, block_size=8, prefill_chunk=8,
+              temperature=0.8, top_k=10, snapshot_dir=str(tmp_path / "s"))
+    eng = ServeEngine(cfg, params, **kw)
+    _submit_all(eng, prompts=prompts, max_new=max_new)
+    for _ in range(5):
+        eng.step()
+    assert eng.save_snapshot() is not None
+    eng.step()
+    eng.close()
+    eng2 = ServeEngine(cfg, params, **kw)
+    assert eng2.restore_latest_snapshot() is not None
+    eng2.run()
+    for i in range(2):
+        assert eng2.completions()[i] == _oracle(
+            cfg, params, i, 0.8, 10, prompts=prompts, max_new=max_new), \
+            f"req {i} kv={kv} impl={impl}"
+    eng2.sched.pool.check_leaks()
+    eng2.close()
+
+
+# ---- kill mid-snapshot, across real process boundaries (out of tier-1) ------
+
+
+def _target_serve_kill_mid_snapshot(snap_dir, phase):
+    """Subprocess target: phase "serve" snapshots durably, races an async
+    snapshot against the parent's SIGKILL; phase "restore" restores the
+    newest VALID snapshot in a fresh process and drains."""
+    import pathlib
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_guide_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+    )
+    from distributed_tensorflow_guide_tpu.serve.engine import (
+        Request,
+        ServeEngine,
+    )
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=2,
+                            d_model=16, d_ff=32, max_len=64, causal=True,
+                            dtype=jnp.float32)
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))["params"]
+    eng = ServeEngine(cfg, params, slots=2, num_blocks=33, block_size=8,
+                      prefill_chunk=8, temperature=0.8, top_k=10,
+                      snapshot_dir=snap_dir)
+    if phase == "serve":
+        prompts = [np.array([3, 5, 7, 9, 11], np.int32),
+                   np.array([2, 4, 6, 8, 10, 12, 14, 16, 18], np.int32)]
+        for i, p in enumerate(prompts):
+            eng.submit(Request(rid=i, prompt=p, max_new_tokens=8,
+                               rng=jax.random.PRNGKey(100 + i)))
+        for _ in range(5):
+            eng.step()
+        eng.save_snapshot()  # the durable baseline
+        for _ in range(3):
+            eng.step()
+        eng.save_snapshot(async_=True)  # the kill races this commit
+        pathlib.Path(snap_dir, "saved_marker").touch()
+        _time.sleep(600)  # hold still; the parent kills us here
+    label = eng.restore_latest_snapshot()
+    eng.run()
+    eng.close()
+    return {"label": label,
+            "completions": {int(k): list(v)
+                            for k, v in eng.completions().items()}}
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_kill_mid_snapshot_then_restore_bitwise(tmp_path, params):
+    """Run 1 is SIGKILLed while an async snapshot may still be mid-write
+    — a real engine crash. Run 2 (a fresh process) must restore the
+    newest snapshot that VERIFIES (the torn one is skipped by the
+    manifest ladder) and finish every stream bitwise."""
+    import pathlib
+
+    from distributed_tensorflow_guide_tpu.runtime.multiprocess import (
+        MultiProcessRunner,
+        run_multiprocess,
+    )
+
+    d = str(tmp_path / "snap")
+    runner = MultiProcessRunner(
+        _target_serve_kill_mid_snapshot, 1, args=(d, "serve"), timeout=120,
+    ).start()
+    marker = pathlib.Path(d) / "saved_marker"
+    deadline = time.time() + 90
+    while time.time() < deadline and not marker.exists():
+        time.sleep(0.02)
+    assert marker.exists(), "run 1 never reached its snapshot point"
+    runner.kill(0)  # SIGKILL: no barriers, no atexit — a real engine crash
+    results = runner.join(raise_on_error=False)
+    assert not results[0].ok
+
+    results = run_multiprocess(_target_serve_kill_mid_snapshot, 1,
+                               args=(d, "restore"), timeout=120)
+    r = results[0].result
+    assert r["label"] is not None  # SOME durable snapshot verified
+    prompts = [np.array([3, 5, 7, 9, 11], np.int32),
+               np.array([2, 4, 6, 8, 10, 12, 14, 16, 18], np.int32)]
+    for i in (0, 1):  # JSON round-trip: rid keys come back as strings
+        assert r["completions"][str(i)] == _oracle(
+            CFG, params, i, 0.8, 10, prompts=prompts, max_new=[8, 8]), \
+            f"req {i} diverged across the kill"
