@@ -4,9 +4,11 @@
 This is the subprocess that makes ``dtg-lint`` part of tier-1: it forces
 the pinned 8-fake-CPU-device geometry, traces EVERY registered
 :class:`~distributed_tensorflow_guide_tpu.analysis.contracts.ProgramContract`
-and runs all five rule families — exactly what the standalone CLI does —
-then emits the one-line JSON contract. ``value`` is the number of clean
-programs; rc is 1 if any program violates its contract, so a lint
+(12 programs as of round 12 — the serve family carries three: base
+decode step, prefill-chunk step, and the gathered multi-LoRA decode
+step) and runs all five rule families — exactly what the standalone CLI
+does — then emits the one-line JSON contract. ``value`` is the number of
+clean programs; rc is 1 if any program violates its contract, so a lint
 regression fails the smoke suite (and tests/test_benchmarks.py) loudly.
 
 Lint is trace-time only (nothing compiles, nothing executes), so this is

@@ -97,6 +97,17 @@ def main() -> None:
                     help="with the chaos phase: periodic engine "
                          "snapshots, a mid-run kill, restore from the "
                          "latest valid snapshot (implies --chaos)")
+    ap.add_argument("--prefix-mix", type=int, default=0, metavar="N",
+                    help="add the prefix-sharing phase (PR 12): N "
+                         "tenants share a common system prompt; the same "
+                         "top-rate mix runs prefix-cache ON vs OFF in "
+                         "one invocation (TTFT A/B + tokens saved), "
+                         "plus a tenant-0 burst under a slots quota "
+                         "(fair-share bound)")
+    ap.add_argument("--lora-rank", type=int, default=0,
+                    help="serve the continuous side multi-LoRA: each "
+                         "request decodes under adapter rid %% 4 (0 = "
+                         "base) through the gathered-delta step programs")
     ap.add_argument("--small", action="store_true")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
@@ -143,6 +154,27 @@ def main() -> None:
         jax.random.PRNGKey(0),
         jnp.zeros((1, cfg.max_len), jnp.int32))["params"]
 
+    # multi-LoRA: the continuous side's config gains the delta banks;
+    # the static baseline stays the base model (adapter 0 is bitwise
+    # base, so the A/B is still apples-to-apples for tagged requests)
+    n_adapters = 3 if args.lora_rank else 0
+    serve_cfg, bank = cfg, None
+    if args.lora_rank:
+        from distributed_tensorflow_guide_tpu.serve.engine import (
+            init_adapter_bank,
+        )
+
+        serve_cfg = dataclasses.replace(
+            cfg, lora_rank=args.lora_rank, lora_adapters=n_adapters)
+        leaves, treedef = jax.tree.flatten(init_adapter_bank(serve_cfg))
+        keys = jax.random.split(jax.random.PRNGKey(args.seed + 3), len(leaves))
+        bank = jax.tree.unflatten(treedef, [
+            (0.02 * jax.random.normal(k, l.shape, l.dtype)).at[0].set(0.0)
+            for k, l in zip(keys, leaves)])
+
+    def adapter_of(rid):
+        return rid % (n_adapters + 1) if args.lora_rank else 0
+
     def make_workload(rate, n, tag):
         """Deterministic per-rate trace: a fresh seeded stream makes the
         LENGTH/token sequence identical across rates (same draw order),
@@ -158,37 +190,39 @@ def main() -> None:
         return out
 
     # ---- continuous side ------------------------------------------------
-    eng = ServeEngine(cfg, params, slots=args.slots,
+    eng = ServeEngine(serve_cfg, params, slots=args.slots,
                       num_blocks=args.num_blocks,
                       block_size=args.block_size,
                       prefill_chunk=args.prefill_chunk,
-                      temperature=0.0)
+                      temperature=0.0, adapters=bank)
 
-    def drive(workload):
+    def drive(workload, e=None):
         """Virtual clock: launches charged their measured wall time,
         idle gaps skipped. Returns (events, mean live blocks)."""
-        for rid, arr, toks, M in workload:
-            eng.submit(Request(rid=rid, prompt=toks, max_new_tokens=M,
-                               rng=jax.random.PRNGKey(rid % (1 << 20)),
-                               arrival=arr))
+        e = eng if e is None else e
+        for rid, arr, toks, M, *rest in workload:
+            e.submit(Request(rid=rid, prompt=toks, max_new_tokens=M,
+                             rng=jax.random.PRNGKey(rid % (1 << 20)),
+                             arrival=arr, adapter=adapter_of(rid),
+                             tenant=rest[0] if rest else 0))
         now, events, live = 0.0, [], []
-        while eng.sched.has_queued or eng.sched.has_resident:
+        while e.sched.has_queued or e.sched.has_resident:
             t0 = time.perf_counter()
-            evs, kind = eng.step(now)
+            evs, kind = e.step(now)
             dt = time.perf_counter() - t0
             if kind == "idle":
-                nxt = eng.sched.next_arrival()
+                nxt = e.sched.next_arrival()
                 if nxt is None:
                     break
                 now = max(now, nxt)
                 continue
             now += dt
-            live.append(eng.live_blocks())
-            events.extend(dataclasses.replace(e, time=now) for e in evs)
+            live.append(e.live_blocks())
+            events.extend(dataclasses.replace(ev, time=now) for ev in evs)
         return events, (sum(live) / len(live) if live else 0.0)
 
     def latencies(events, workload):
-        arr = {rid: a for rid, a, _, _ in workload}
+        arr = {w[0]: w[1] for w in workload}
         firsts, lasts, counts = {}, {}, {}
         for e in events:
             if e.rid not in arr:
@@ -480,6 +514,125 @@ def main() -> None:
         for e in (e1, e2):
             if e is not None:
                 e.close()
+
+    # ---- prefix-sharing + tenancy phase (PR 12) --------------------------
+    prefix_extras = {}
+    if args.prefix_mix:
+        NT = args.prefix_mix
+        # the shared system prompt: a multiple of both the block size and
+        # the prefill chunk, so a repeat claim covers it exactly
+        import math
+
+        g = math.lcm(args.block_size, args.prefill_chunk)
+        sfx_len = 8
+        # largest shareable prompt the geometry affords: bounded by the
+        # position budget AND by each resident's fair share of the pool
+        budget = min(cfg.max_len,
+                     (args.num_blocks - 1) * args.block_size // args.slots)
+        sys_len = max(g, (budget - sfx_len - min(mnews)) // g * g)
+        if sys_len + sfx_len + min(mnews) > cfg.max_len:
+            raise SystemExit("--prefix-mix: max_len too small for the "
+                             "system prompt + suffix + decode budget")
+        prng = np.random.RandomState(args.seed * 31337 + 7)
+        sys_prompt = prng.randint(0, cfg.vocab_size, sys_len).astype(np.int32)
+
+        def make_prefix_workload(rate, n, tag, tenant_of_i=None):
+            rng = np.random.RandomState(args.seed * 6007 + tag)
+            now, out = 0.0, []
+            for i in range(n):
+                now += rng.exponential(1.0 / rate)
+                sfx = rng.randint(0, cfg.vocab_size,
+                                  sfx_len).astype(np.int32)
+                toks = np.concatenate([sys_prompt, sfx])
+                out.append((tag * 100000 + i, now, toks, int(min(mnews)),
+                            (i % NT) if tenant_of_i is None
+                            else tenant_of_i(i)))
+            return out
+
+        def prefix_engine(on, quotas=None):
+            return ServeEngine(
+                serve_cfg, params, slots=args.slots,
+                num_blocks=args.num_blocks, block_size=args.block_size,
+                prefill_chunk=args.prefill_chunk, temperature=0.0,
+                adapters=bank, prefix_cache=on, tenant_quotas=quotas)
+
+        def ttft_p50_of(e, wl):
+            ev, _ = drive(wl, e)
+            lat = latencies(ev, wl)
+            by_tenant = {}
+            wl_tenant = {w[0]: w[4] for w in wl}
+            firsts = {x.rid: x.time for x in ev
+                      if x.first and x.status == "ok"}
+            arr = {w[0]: w[1] for w in wl}
+            for rid, t in firsts.items():
+                if rid in arr:
+                    by_tenant.setdefault(wl_tenant[rid], []).append(
+                        t - arr[rid])
+            p50 = float(np.median([x[0] for x in lat])) if lat else 0.0
+            return p50, lat, by_tenant
+
+        rate = rates[top]
+        # one untimed warmup request per engine: populates the trie (ON
+        # side) so the measured wave hits it, and keeps the two sides'
+        # work symmetric (compile state is already shared via the step-fn
+        # memo). latencies() drops the warmup rid — it is not in the
+        # measured workload's arrival map.
+        warm = make_prefix_workload(1e9, 1, tag=43)
+        wl_on = make_prefix_workload(rate, args.requests, tag=40)
+        e_on = prefix_engine(on=True)
+        drive(warm, e_on)
+        ttft_on, lat_on, by_t_on = ttft_p50_of(e_on, wl_on)
+        h_on = e_on.health()
+        good_on = goodput(lat_on, slo_ttft, slo_tpot, wl_on[0][1])
+        e_on.close()
+        e_on.sched.pool.check_leaks()
+
+        wl_off = make_prefix_workload(rate, args.requests, tag=40)
+        e_off = prefix_engine(on=False)
+        drive(warm, e_off)
+        ttft_off, lat_off, _ = ttft_p50_of(e_off, wl_off)
+        good_off = goodput(lat_off, slo_ttft, slo_tpot, wl_off[0][1])
+        e_off.close()
+
+        # fair-share leg: tenant 0 floods (3x everyone's volume at once)
+        # under a slots quota — the victims' TTFT must stay bounded
+        burst_extra = make_prefix_workload(
+            1e9, 3 * args.requests, tag=41, tenant_of_i=lambda i: 0)
+        steady = make_prefix_workload(rate, args.requests, tag=42)
+        e_fair = prefix_engine(
+            on=True, quotas={0: {"slots": max(1, args.slots // 2)}})
+        drive(warm, e_fair)
+        wl_fair = sorted(burst_extra + steady, key=lambda w: w[1])
+        _, lat_fair, by_t_fair = ttft_p50_of(e_fair, wl_fair)
+        fair_health = e_fair.health()
+        e_fair.close()
+        victims_on = [v for t, vs in by_t_on.items() if t != 0
+                      for v in vs]
+        victims_fair = [v for t, vs in by_t_fair.items() if t != 0
+                        for v in vs]
+        victim_ratio = (
+            float(np.median(victims_fair) / max(np.median(victims_on),
+                                                1e-9))
+            if victims_on and victims_fair else 0.0)
+
+        prefix_extras = {
+            "prefix_mix_tenants": NT,
+            "prefix_sys_len": int(sys_len),
+            "prefix_ttft_p50_on": round(ttft_on, 4),
+            "prefix_ttft_p50_off": round(ttft_off, 4),
+            "prefix_ttft_speedup": round(ttft_off / max(ttft_on, 1e-9), 2),
+            "prefix_goodput_on": round(good_on, 2),
+            "prefix_goodput_off": round(good_off, 2),
+            "prefix_hit_tokens": h_on["prefix_hit_tokens"],
+            "prefill_tokens_saved": h_on["prefill_tokens_saved"],
+            "prefix_evictions": h_on["prefix_evictions"],
+            "fair_share_victim_ttft_ratio": round(victim_ratio, 2),
+            "fair_share_tenants": {
+                t: {"done": c["done"], "tokens": c["tokens"],
+                    "shed": c["shed"]}
+                for t, c in fair_health["tenants"].items()},
+        }
+
     # ---- the JSON line ---------------------------------------------------
     side = cont_good if args.mode == "continuous" else static_good
     other = static_good if args.mode == "continuous" else cont_good
@@ -510,6 +663,7 @@ def main() -> None:
             cfg, args.slots),
     }
     extras.update(chaos_extras)
+    extras.update(prefix_extras)
     report("serve_goodput", side[top], "tokens/sec",
            baseline=other[top] if other[top] > 0 else None,
            **extras)

@@ -131,9 +131,12 @@ SMOKE = {
         # goodput/TTFT/TPOT numbers and the continuous-vs-static A/B are
         # real on CPU (rates and SLOs self-calibrate to the machine);
         # --chaos/--snapshot-restore run the serving-under-fire phase
-        # (fault storm, mid-run kill, restore) in the same smoke
+        # (fault storm, mid-run kill, restore) and --prefix-mix the
+        # prefix-sharing/tenancy phase (cache ON vs OFF A/B + the
+        # tenant-0 burst fairness leg) in the same smoke — no extra
+        # compiles, the phases reuse the main engine's two programs
         ["--fake-devices", "1", "--small", "--requests", "6",
-         "--chaos", "--snapshot-restore"],
+         "--chaos", "--snapshot-restore", "--prefix-mix", "2"],
     "bench_lint.py":
         # NOT a liveness stub either: lint is trace-time only, so the
         # smoke run IS the full registry audit at the pinned 8-device

@@ -179,6 +179,23 @@ BATTERY: list[tuple[str, list[str], int]] = [
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "dense", "--chaos", "--snapshot-restore"], 1800),
+    # prefix sharing + tenancy (PR 12): one knob each — chunked prefill
+    # + the prefix-mix phase (prefix cache ON vs OFF in one run), the
+    # same under chunking-off geometry (tenancy/fair-share focus), then
+    # + batched multi-LoRA decode
+    ("serve_prefix_cache",
+     ["benchmarks/bench_serving.py", "--mode", "continuous",
+      "--prefill-chunk", "8", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--prefix-mix", "3"], 1800),
+    ("serve_multi_tenant",
+     ["benchmarks/bench_serving.py", "--mode", "continuous",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--prefix-mix", "4"], 1800),
+    ("serve_lora",
+     ["benchmarks/bench_serving.py", "--mode", "continuous",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--prefix-mix", "3",
+      "--lora-rank", "2"], 1800),
     ("ring_attention_1024",
      ["benchmarks/bench_ring_attention.py", "--seq-len", "1024"], 1500),
     ("ring_attention_2048",

@@ -45,6 +45,9 @@ FIRST_WINDOW = [
     "serve_continuity",        # serving A/B (PR 10): static baseline,
     "serve_paged",             # continuous batching + paged KV,
     "serve_chunked_prefill",   # + chunked prefill interleave
+    "serve_prefix_cache",      # prefix-sharing COW cache A/B (PR 12),
+    "serve_multi_tenant",      # + fair-share tenancy under burst,
+    "serve_lora",              # + batched multi-LoRA decode
     "gpt2_pp_fused_ce",
     "gpt2_pp_gpipe",
     "gpt2_flash_seq1024",

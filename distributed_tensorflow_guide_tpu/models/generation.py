@@ -201,7 +201,8 @@ def sample_rows(logits, keys, temperature: float, top_k: int | None):
 def make_generate_fn(cfg: TransformerConfig, *, max_new_tokens: int,
                      temperature: float = 1.0, top_k: int | None = None,
                      donate_cache: bool = True, unroll: int = 1,
-                     spec_draft_layers: int = 0, spec_lookahead: int = 4):
+                     spec_draft_layers: int = 0, spec_lookahead: int = 4,
+                     adapters=None, adapter_id: int = 0):
     """Build a jitted ``(params, prompt (B, P) int32, rng) -> (B, P + N)``
     generator. Compiles once per (B, P) shape; P + max_new_tokens (+ the
     speculative lookahead, when on) must fit ``cfg.max_len`` (checked
@@ -257,18 +258,43 @@ def make_generate_fn(cfg: TransformerConfig, *, max_new_tokens: int,
     if spec:
         draft_cfg = dataclasses.replace(cfg, num_layers=spec_draft_layers)
         draft_model = Transformer(decode_config(draft_cfg))
+    # Multi-LoRA one-shot path (the serve engine's per-adapter oracle):
+    # ``adapters`` is the bank tree ("adapters" collection) and
+    # ``adapter_id`` selects one row for the whole batch. The bank is
+    # closed over (a jit constant — the oracle serves parity tests, not
+    # production traffic), and the adapter-free trace stays verbatim.
+    lora = dcfg.lora_rank is not None
+    if lora and adapters is None:
+        raise ValueError(
+            "cfg.lora_rank set: pass the adapters bank "
+            "(serve.init_adapter_bank)")
+    if not lora and adapters is not None:
+        raise ValueError("adapters given but cfg.lora_rank is None")
+    if lora and not 0 <= adapter_id <= cfg.lora_adapters:
+        raise ValueError(
+            f"adapter_id {adapter_id} out of range "
+            f"[0, {cfg.lora_adapters}]")
+    if lora and spec:
+        raise ValueError("speculative decoding + LoRA is not supported")
+
+    def _apply(params, cache, toks, idx):
+        variables = {"params": params, "cache": cache}
+        if not lora:
+            return model.apply(variables, toks, idx, mutable=["cache"])
+        variables["adapters"] = adapters
+        ids = jnp.full((toks.shape[0],), adapter_id, jnp.int32)
+        return model.apply(variables, toks, idx, adapter=ids,
+                           mutable=["cache"])
 
     def _generate(params, prompt, cache, rng):
         B, P = prompt.shape
         # prefill: the whole prompt in one forward pass, cache filled
-        logits, vs = model.apply({"params": params, "cache": cache},
-                                 prompt, 0, mutable=["cache"])
+        logits, vs = _apply(params, cache, prompt, 0)
         tok = sample(logits[:, -1], jax.random.fold_in(rng, P))
 
         def body(carry, _):
             cache, tok, idx = carry
-            logits, vs = model.apply({"params": params, "cache": cache},
-                                     tok[:, None], idx, mutable=["cache"])
+            logits, vs = _apply(params, cache, tok[:, None], idx)
             nxt = sample(logits[:, -1], jax.random.fold_in(rng, idx + 1))
             return (vs["cache"], nxt, idx + 1), tok
 

@@ -115,6 +115,19 @@ class TransformerConfig:
     # the one-shot path (both None) is untouched.
     paged_num_blocks: int | None = None
     paged_block_size: int | None = None
+    # Batched multi-LoRA (serve/engine.py, PR 12): ``lora_rank`` set → every
+    # projection (attention qkv/proj, MLP up/down) owns a BANK of
+    # ``lora_adapters + 1`` low-rank (A, B) delta pairs in the flax
+    # "adapters" collection (row 0 is all-zero = the base model), and a call
+    # may pass a per-request (B,) int32 ``adapter`` id vector: the deltas
+    # are GATHERED by id and applied as one batched einsum per projection,
+    # so one compiled step serves many fine-tunes (no per-adapter
+    # programs). ``adapter=None`` (and lora_rank=None) keep every
+    # historical trace byte-identical; adapter id 0 is bitwise the base
+    # model at the token-stream level (a zero delta cannot move an argmax
+    # or a gumbel comparison).
+    lora_rank: int | None = None
+    lora_adapters: int = 0
 
     def __post_init__(self):
         if self.attn_impl not in ("auto", "dense", "flash"):
@@ -152,10 +165,24 @@ class TransformerConfig:
                 raise ValueError(
                     "paged_num_blocks must be >= 2 (one is the trash block)"
                 )
+        if self.lora_rank is not None:
+            if self.lora_rank < 1:
+                raise ValueError(
+                    f"lora_rank must be >= 1, got {self.lora_rank}")
+            if self.lora_adapters < 1:
+                raise ValueError(
+                    "lora_rank set requires lora_adapters >= 1 "
+                    f"(got {self.lora_adapters})")
+        elif self.lora_adapters:
+            raise ValueError("lora_adapters requires lora_rank")
 
     @property
     def paged(self) -> bool:
         return self.paged_num_blocks is not None
+
+    @property
+    def lora(self) -> bool:
+        return self.lora_rank is not None
 
     @property
     def resolved_remat_mode(self) -> str:
@@ -245,12 +272,35 @@ def _dense_init(*names):
 # (shard_map paths must not emit wsc).
 
 
+def _lora_bank(module: nn.Module, cfg: TransformerConfig, name: str,
+               d_in: int, d_out: int):
+    """The (A, B) delta bank of one projection: ``lora_adapters + 1`` rows
+    (row 0 all-zero = the base model), created at init whenever
+    ``cfg.lora_rank`` is set so the "adapters" collection has known shapes
+    regardless of whether a call passes adapter ids."""
+    n_bank = cfg.lora_adapters + 1
+    a = module.variable("adapters", f"{name}_A", jnp.zeros,
+                        (n_bank, d_in, cfg.lora_rank), cfg.dtype)
+    b = module.variable("adapters", f"{name}_B", jnp.zeros,
+                        (n_bank, cfg.lora_rank, d_out), cfg.dtype)
+    return a, b
+
+
+def _lora_delta(a, b, x: jax.Array, adapter: jax.Array) -> jax.Array:
+    """x @ A[id] @ B[id] with per-request ids — ONE gathered batched
+    einsum pair serves every adapter resident in the batch."""
+    a_e = jnp.take(a.value, adapter, axis=0)  # (B, d_in, r)
+    b_e = jnp.take(b.value, adapter, axis=0)  # (B, r, d_out)
+    t = jnp.einsum("bcd,bdr->bcr", x, a_e)
+    return jnp.einsum("bcr,bre->bce", t, b_e)
+
+
 class MultiHeadAttention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x: jax.Array, index=None, *,
-                 block_tables=None) -> jax.Array:  # (B, S, D)
+                 block_tables=None, adapter=None) -> jax.Array:  # (B, S, D)
         cfg = self.cfg
         h, hd = cfg.num_heads, cfg.head_dim
         if cfg.tp_axis:  # Megatron f: identity fwd, psum bwd (see tp_axis doc)
@@ -263,6 +313,12 @@ class MultiHeadAttention(nn.Module):
             use_bias=False,
             name="qkv",
         )(x)
+        if cfg.lora:
+            qkv_a, qkv_b = _lora_bank(self, cfg, "qkv",
+                                      cfg.d_model, 3 * h * hd)
+            if adapter is not None:
+                qkv = qkv + _lora_delta(qkv_a, qkv_b, x,
+                                        adapter).reshape(qkv.shape)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B, S, H, hd)
         # "seq_inner": inside a sub-layer the sequence dim is deliberately
         # a DIFFERENT logical axis from the residual stream's "seq" — under
@@ -298,6 +354,7 @@ class MultiHeadAttention(nn.Module):
                 scores.astype(jnp.float32), axis=-1
             ).astype(cfg.dtype)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        proj_in = out
         out = nn.DenseGeneral(
             cfg.d_model,
             axis=(-2, -1),
@@ -306,6 +363,12 @@ class MultiHeadAttention(nn.Module):
             use_bias=False,
             name="proj",
         )(out)
+        if cfg.lora:
+            proj_a, proj_b = _lora_bank(self, cfg, "proj",
+                                        h * hd, cfg.d_model)
+            if adapter is not None:
+                flat = proj_in.reshape(proj_in.shape[:2] + (h * hd,))
+                out = out + _lora_delta(proj_a, proj_b, flat, adapter)
         if cfg.tp_axis:  # Megatron g: psum fwd (row-parallel proj), id bwd
             out = tp_allreduce(out, cfg.tp_axis)
         return out
@@ -581,7 +644,7 @@ class MLP(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, *, adapter=None) -> jax.Array:
         cfg = self.cfg
         if cfg.tp_axis:  # Megatron f
             x = tp_identity(x, cfg.tp_axis)
@@ -594,8 +657,13 @@ class MLP(nn.Module):
             ),
             name="up",
         )(x)
+        if cfg.lora:
+            up_a, up_b = _lora_bank(self, cfg, "up", cfg.d_model, cfg.d_ff)
+            if adapter is not None:
+                y = y + _lora_delta(up_a, up_b, x, adapter)
         y = nn.gelu(y)
         y = _constrain(y, ("batch", "seq_inner", "mlp"))
+        down_in = y
         y = nn.Dense(
             cfg.d_model,
             dtype=cfg.dtype,
@@ -603,6 +671,11 @@ class MLP(nn.Module):
             use_bias=False,
             name="down",
         )(y)
+        if cfg.lora:
+            down_a, down_b = _lora_bank(self, cfg, "down",
+                                        cfg.d_ff, cfg.d_model)
+            if adapter is not None:
+                y = y + _lora_delta(down_a, down_b, down_in, adapter)
         if cfg.tp_axis:  # Megatron g (row-parallel down-projection)
             y = tp_allreduce(y, cfg.tp_axis)
         return y
@@ -615,7 +688,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, index=None, *,
-                 block_tables=None) -> jax.Array:
+                 block_tables=None, adapter=None) -> jax.Array:
         cfg = self.cfg
         # Attention-only selective remat (core/precision.py): checkpoint the
         # attention sub-layer here so EVERY consumer — the flat Transformer,
@@ -628,13 +701,20 @@ class Block(nn.Module):
             attn_cls = nn.remat(MultiHeadAttention, prevent_cse=False)
         attn = attn_cls(cfg, name="attn")
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
-        if block_tables is None:  # the historical call, kept verbatim
+        if block_tables is None and adapter is None:
+            # the historical call, kept verbatim
             x = x + attn(h, index)
-        else:
+        elif adapter is None:
             x = x + attn(h, index, block_tables=block_tables)
-        x = x + MLP(cfg, name="mlp")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
-        )
+        else:
+            x = x + attn(h, index, block_tables=block_tables,
+                         adapter=adapter)
+        mlp = MLP(cfg, name="mlp")
+        h2 = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+        if adapter is None:  # the historical call, kept verbatim
+            x = x + mlp(h2)
+        else:
+            x = x + mlp(h2, adapter=adapter)
         return _constrain(x, ("batch", "seq", "embed"))
 
 
@@ -646,7 +726,7 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array, index=None, *,
-                 block_tables=None,
+                 block_tables=None, adapter=None,
                  return_hidden: bool = False) -> jax.Array:
         # tokens (B, S) int32; ``index`` only in cfg.decode mode: the
         # absolute position of tokens[:, 0] (prefill passes 0, the decode
@@ -689,11 +769,15 @@ class Transformer(nn.Module):
         if cfg.resolved_remat_mode == "block":
             block = nn.remat(Block, prevent_cse=False)
         for i in range(cfg.num_layers):
-            if block_tables is None:  # the historical call, kept verbatim
+            if block_tables is None and adapter is None:
+                # the historical call, kept verbatim
                 x = block(cfg, name=f"block_{i}")(x, index)
-            else:
+            elif adapter is None:
                 x = block(cfg, name=f"block_{i}")(
                     x, index, block_tables=block_tables)
+            else:
+                x = block(cfg, name=f"block_{i}")(
+                    x, index, block_tables=block_tables, adapter=adapter)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         if return_hidden:
             return x

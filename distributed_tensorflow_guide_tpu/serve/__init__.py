@@ -1,10 +1,14 @@
 """Continuous-batching serving: paged KV pool + request scheduler +
-two static step programs (see docs/serving.md)."""
+two static step programs, with prefix-sharing COW blocks, multi-tenant
+fair-share admission and batched multi-LoRA decode (see
+docs/serving.md)."""
 
 from distributed_tensorflow_guide_tpu.serve.engine import (
     Event,
     ServeEngine,
+    adapter_bank_shapes,
     build_step_fns,
+    init_adapter_bank,
     paged_cache_pool,
     paged_config,
 )
@@ -18,6 +22,9 @@ from distributed_tensorflow_guide_tpu.serve.paged_cache import (
     scatter_chunk,
     table_row,
 )
+from distributed_tensorflow_guide_tpu.serve.prefix_index import (
+    PrefixIndex,
+)
 from distributed_tensorflow_guide_tpu.serve.scheduler import (
     Request,
     Scheduler,
@@ -27,12 +34,15 @@ __all__ = [
     "BlockPool",
     "EngineOverloaded",
     "Event",
+    "PrefixIndex",
     "Request",
     "Scheduler",
     "ServeEngine",
+    "adapter_bank_shapes",
     "blocks_for",
     "build_step_fns",
     "gather_view",
+    "init_adapter_bank",
     "paged_cache_pool",
     "paged_config",
     "scatter_chunk",

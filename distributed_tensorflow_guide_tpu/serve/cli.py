@@ -30,6 +30,14 @@ def main() -> None:
     ap.add_argument("--temperature", type=float, default=0.8)
     ap.add_argument("--top-k", type=int, default=20)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="share a common system prompt across the demo "
+                         "requests through the radix prefix cache "
+                         "(watch prefill_tokens_saved in health())")
+    ap.add_argument("--lora-rank", type=int, default=0,
+                    help="serve the mix multi-LoRA: requests cycle "
+                         "through 3 adapters (0 = base) inside the "
+                         "shared decode step")
     args = ap.parse_args()
 
     # device env before any jax import (the dtg-lint pattern)
@@ -48,9 +56,25 @@ def main() -> None:
         ServeEngine,
     )
 
+    import dataclasses
+
     cfg = TransformerConfig(vocab_size=256, num_layers=2, num_heads=2,
                             d_model=32, d_ff=64, max_len=64, causal=True,
                             dtype=jnp.float32)
+    bank = None
+    if args.lora_rank:
+        from distributed_tensorflow_guide_tpu.serve.engine import (
+            init_adapter_bank,
+        )
+
+        cfg = dataclasses.replace(cfg, lora_rank=args.lora_rank,
+                                  lora_adapters=2)
+        leaves, treedef = jax.tree.flatten(init_adapter_bank(cfg))
+        keys = jax.random.split(jax.random.PRNGKey(args.seed + 7),
+                                len(leaves))
+        bank = jax.tree.unflatten(treedef, [
+            (0.05 * jax.random.normal(k, l.shape, l.dtype)).at[0].set(0.0)
+            for k, l in zip(keys, leaves)])
     params = Transformer(cfg).init(
         jax.random.PRNGKey(args.seed),
         jnp.zeros((1, 8), jnp.int32))["params"]
@@ -58,15 +82,23 @@ def main() -> None:
                       num_blocks=args.num_blocks,
                       block_size=args.block_size,
                       prefill_chunk=args.prefill_chunk,
-                      temperature=args.temperature, top_k=args.top_k)
+                      temperature=args.temperature, top_k=args.top_k,
+                      prefix_cache=args.prefix_cache, adapters=bank)
     rng = np.random.RandomState(args.seed)
+    sys_prompt = (rng.randint(0, cfg.vocab_size, 16).astype(np.int32)
+                  if args.prefix_cache else None)
     for rid in range(args.requests):
         plen = int(rng.choice([4, 8, 16]))
+        prompt = rng.randint(0, cfg.vocab_size, plen).astype(np.int32)
+        if sys_prompt is not None:
+            prompt = np.concatenate([sys_prompt, prompt[:4]])
         eng.submit(Request(
             rid=rid,
-            prompt=rng.randint(0, cfg.vocab_size, plen).astype(np.int32),
+            prompt=prompt,
             max_new_tokens=args.max_new,
-            rng=jax.random.PRNGKey(args.seed * 1000 + rid)))
+            rng=jax.random.PRNGKey(args.seed * 1000 + rid),
+            adapter=(rid % 3 if args.lora_rank else 0),
+            tenant=rid % 2))
     for ev in eng.run():
         if ev.status != "ok":
             print(f"req {ev.rid:3d} ! {ev.status}")

@@ -86,7 +86,7 @@ from distributed_tensorflow_guide_tpu.utils.watchdog import (
 
 __all__ = ["Event", "Request", "ServeEngine", "EngineOverloaded",
            "WatchdogTimeout", "build_step_fns", "paged_cache_pool",
-           "lint_contracts"]
+           "adapter_bank_shapes", "init_adapter_bank", "lint_contracts"]
 
 # pool-pressure chaos faults allocate under this reserved owner id (real
 # rids are non-negative) and release after this many engine ticks
@@ -140,6 +140,29 @@ def paged_cache_pool(pcfg: TransformerConfig, slots: int):
                         paged_cache_shapes(pcfg, slots))
 
 
+def adapter_bank_shapes(cfg: TransformerConfig):
+    """Abstract tree of the multi-LoRA (A, B) delta banks (the flax
+    "adapters" collection) — derived from the model exactly like the
+    pool so user-supplied banks can never drift from what the step
+    programs trace. Bank shapes are independent of slots/paging (each
+    site is ``(lora_adapters + 1, d_in, rank)`` x ``(..., rank, d_out)``),
+    so any config with the same lora geometry yields the same tree.
+    Requires ``cfg.lora_rank``."""
+    if cfg.lora_rank is None:
+        raise ValueError("adapter_bank_shapes requires cfg.lora_rank")
+    model = Transformer(cfg)
+    variables = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 1), jnp.int32))
+    return variables["adapters"]
+
+
+def init_adapter_bank(cfg: TransformerConfig):
+    """A zeroed adapter bank: every id (including every non-zero one)
+    starts bitwise-base until its rows are written."""
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                        adapter_bank_shapes(cfg))
+
+
 _STEP_FNS = {}
 
 
@@ -164,33 +187,66 @@ def build_step_fns(cfg: TransformerConfig, *, slots: int, num_blocks: int,
     pcfg = paged_config(cfg, num_blocks=num_blocks, block_size=block_size)
     model = Transformer(pcfg)
     n_blk = pcfg.max_len // block_size
+    lora = pcfg.lora_rank is not None
 
-    def decode_step(params, pool, tables, written, last_tok, keys):
-        """(S,) tokens in, (S,) tokens out; pool threaded state->state."""
-        logits, mut = model.apply(
-            {"params": params, "cache": pool},
-            last_tok[:, None], written, block_tables=tables,
-            mutable=["cache"])
-        pos_keys = jax.vmap(jax.random.fold_in)(keys, written + 1)
-        nxt = sample_rows(logits[:, -1], pos_keys, temperature, top_k)
-        return nxt, mut["cache"]
+    if lora:
+        # still exactly two jitted programs: the LoRA engine's pair takes
+        # two extra operands — the shared (A, B) delta banks and the
+        # per-slot adapter-id vector — and every slot's delta is gathered
+        # by id inside the one compiled step (no per-adapter programs)
+        def decode_step(params, pool, tables, written, last_tok, keys,
+                        adapters, adapter_ids):
+            logits, mut = model.apply(
+                {"params": params, "cache": pool, "adapters": adapters},
+                last_tok[:, None], written, block_tables=tables,
+                adapter=adapter_ids, mutable=["cache"])
+            pos_keys = jax.vmap(jax.random.fold_in)(keys, written + 1)
+            nxt = sample_rows(logits[:, -1], pos_keys, temperature, top_k)
+            return nxt, mut["cache"]
 
-    def prefill_chunk_step(params, pool, tables, start, chunk, valid, key):
-        """One (1, prefill_chunk) slice of one prompt. ``valid`` is how
-        many rows of the chunk are real prompt (the rest are pads whose
-        writes land inside the admitted blocks and are either overwritten
-        by decode before anything attends them, or masked forever);
-        the returned sample comes from row ``valid - 1`` with the key
-        for absolute position ``start + valid`` — on the final chunk
-        that is exactly the one-shot prefill sample at position P."""
-        logits, mut = model.apply(
-            {"params": params, "cache": pool},
-            chunk, start, block_tables=tables, mutable=["cache"])
-        last = lax.dynamic_index_in_dim(logits[0], valid - 1, axis=0,
-                                        keepdims=False)
-        tok = _sample(last[None], jax.random.fold_in(key, start[0] + valid),
-                      temperature, top_k)[0]
-        return tok, mut["cache"]
+        def prefill_chunk_step(params, pool, tables, start, chunk, valid,
+                               key, adapters, adapter_ids):
+            logits, mut = model.apply(
+                {"params": params, "cache": pool, "adapters": adapters},
+                chunk, start, block_tables=tables,
+                adapter=adapter_ids, mutable=["cache"])
+            last = lax.dynamic_index_in_dim(logits[0], valid - 1, axis=0,
+                                            keepdims=False)
+            tok = _sample(last[None],
+                          jax.random.fold_in(key, start[0] + valid),
+                          temperature, top_k)[0]
+            return tok, mut["cache"]
+    else:
+        def decode_step(params, pool, tables, written, last_tok, keys):
+            """(S,) tokens in, (S,) tokens out; pool threaded
+            state->state."""
+            logits, mut = model.apply(
+                {"params": params, "cache": pool},
+                last_tok[:, None], written, block_tables=tables,
+                mutable=["cache"])
+            pos_keys = jax.vmap(jax.random.fold_in)(keys, written + 1)
+            nxt = sample_rows(logits[:, -1], pos_keys, temperature, top_k)
+            return nxt, mut["cache"]
+
+        def prefill_chunk_step(params, pool, tables, start, chunk, valid,
+                               key):
+            """One (1, prefill_chunk) slice of one prompt. ``valid`` is
+            how many rows of the chunk are real prompt (the rest are pads
+            whose writes land inside the admitted blocks and are either
+            overwritten by decode before anything attends them, or masked
+            forever); the returned sample comes from row ``valid - 1``
+            with the key for absolute position ``start + valid`` — on the
+            final chunk that is exactly the one-shot prefill sample at
+            position P."""
+            logits, mut = model.apply(
+                {"params": params, "cache": pool},
+                chunk, start, block_tables=tables, mutable=["cache"])
+            last = lax.dynamic_index_in_dim(logits[0], valid - 1, axis=0,
+                                            keepdims=False)
+            tok = _sample(last[None],
+                          jax.random.fold_in(key, start[0] + valid),
+                          temperature, top_k)[0]
+            return tok, mut["cache"]
 
     # donation intent is (1,) — the pool — for both programs; the CPU
     # backend doesn't implement input-output aliasing, same gate as
@@ -202,7 +258,7 @@ def build_step_fns(cfg: TransformerConfig, *, slots: int, num_blocks: int,
     fns = SimpleNamespace(
         decode=decode_jit, prefill=prefill_jit, model=model, cfg=pcfg,
         n_blk=n_blk, declared_donate_argnums=(1,), donates_pool=donate,
-        temperature=temperature, top_k=top_k)
+        temperature=temperature, top_k=top_k, lora=lora)
     _STEP_FNS[memo_key] = fns
     return fns
 
@@ -226,7 +282,10 @@ class ServeEngine:
                  step_deadline_s: float | None = None,
                  retry_attempts: int = 3,
                  retry_base_delay_s: float = 0.05,
-                 snapshot_dir=None, snapshot_keep: int = 3):
+                 snapshot_dir=None, snapshot_keep: int = 3,
+                 prefix_cache: bool = False,
+                 tenant_quotas=None, drr_quantum: int | None = None,
+                 adapters=None):
         self.fns = build_step_fns(
             cfg, slots=slots, num_blocks=num_blocks,
             block_size=block_size, prefill_chunk=prefill_chunk,
@@ -236,7 +295,20 @@ class ServeEngine:
         self.sched = Scheduler(
             slots=slots, num_blocks=num_blocks, block_size=block_size,
             prefill_chunk=prefill_chunk, max_len=self.fns.cfg.max_len,
-            max_queue=max_queue)
+            max_queue=max_queue, prefix_cache=prefix_cache,
+            tenant_quotas=tenant_quotas, drr_quantum=drr_quantum)
+        if self.fns.lora:
+            # the bank is a jit-operand (not a closed-over constant):
+            # swapping adapter weights never retraces the two programs
+            self.adapters = jax.tree.map(
+                jnp.asarray,
+                adapters if adapters is not None
+                else init_adapter_bank(self.fns.cfg))
+        elif adapters is not None:
+            raise ValueError(
+                "ServeEngine(adapters=...) requires cfg.lora_rank")
+        else:
+            self.adapters = None
         self.pool = paged_cache_pool(self.fns.cfg, slots)
         self._trash_row = table_row(
             [], self.fns.n_blk, self.sched.pool.trash_block)
@@ -271,6 +343,15 @@ class ServeEngine:
         prompt = np.asarray(req.prompt, np.int32).reshape(-1)
         if prompt.size and int(prompt.max()) >= self.fns.cfg.vocab_size:
             raise ValueError("prompt token out of vocabulary")
+        if self.fns.lora:
+            if not 0 <= req.adapter <= self.fns.cfg.lora_adapters:
+                raise ValueError(
+                    f"request {req.rid} adapter {req.adapter} out of "
+                    f"range [0, {self.fns.cfg.lora_adapters}]")
+        elif req.adapter != 0:
+            raise ValueError(
+                f"request {req.rid} names adapter {req.adapter} but the "
+                "engine config has no lora_rank")
         # predicted-SLO gate: if recent TTFTs already blow this request's
         # TTFT budget, admitting it is a guaranteed miss that would ALSO
         # push every queued request further out — shed at the door
@@ -371,11 +452,14 @@ class ServeEngine:
         chunk[0, :valid] = s.prompt[start:start + valid]
         tables = table_row(s.blocks, self.fns.n_blk,
                            self.sched.pool.trash_block)[None]
-        tok, self.pool = self._launch(
-            lambda: self.fns.prefill(
-                self.params, self.pool, jnp.asarray(tables),
+        args = (self.params, self.pool, jnp.asarray(tables),
                 jnp.full((1,), start, jnp.int32), jnp.asarray(chunk),
-                jnp.int32(valid), jnp.asarray(s.rng)),
+                jnp.int32(valid), jnp.asarray(s.rng))
+        if self.fns.lora:
+            args += (self.adapters,
+                     jnp.full((1,), s.adapter, jnp.int32))
+        tok, self.pool = self._launch(
+            lambda: self.fns.prefill(*args),
             tag="serve_prefill_chunk_step")
         return [Event(now, *ev) for ev in
                 self.sched.apply_prefill(i, int(tok))]
@@ -386,6 +470,7 @@ class ServeEngine:
         written = np.zeros((S,), np.int32)
         last_tok = np.zeros((S,), np.int32)
         keys = np.zeros((S, 2), np.uint32)
+        adapter_ids = np.zeros((S,), np.int32)
         for i in ready:
             s = self.sched.slots[i]
             tables[i] = table_row(s.blocks, n_blk,
@@ -393,11 +478,14 @@ class ServeEngine:
             written[i] = s.written
             last_tok[i] = s.pending
             keys[i] = s.rng
-        nxt, self.pool = self._launch(
-            lambda: self.fns.decode(
-                self.params, self.pool, jnp.asarray(tables),
+            adapter_ids[i] = s.adapter
+        args = (self.params, self.pool, jnp.asarray(tables),
                 jnp.asarray(written), jnp.asarray(last_tok),
-                jnp.asarray(keys)),
+                jnp.asarray(keys))
+        if self.fns.lora:
+            args += (self.adapters, jnp.asarray(adapter_ids))
+        nxt, self.pool = self._launch(
+            lambda: self.fns.decode(*args),
             tag="serve_decode_step")
         nxt = np.asarray(nxt)
         events = []
@@ -424,7 +512,13 @@ class ServeEngine:
                     raise ValueError(
                         "arrival_burst fault needs "
                         "ServeEngine(burst_factory=...)")
-                for req in self.burst_factory(int(f.param), now):
+                # a tenant-targeted burst exercises fair-share admission:
+                # legacy 2-arg factories still work for tenantless faults
+                reqs = (self.burst_factory(int(f.param), now)
+                        if f.tenant is None
+                        else self.burst_factory(int(f.param), now,
+                                                int(f.tenant)))
+                for req in reqs:
                     try:
                         self.submit(req)
                     except EngineOverloaded:
@@ -521,6 +615,11 @@ class ServeEngine:
             "expired": sd.expired,
             "preemptions": sd.preemptions,
             "live_blocks": sd.pool.live_blocks(),
+            "prefix_hit_tokens": sd.prefix_hit_tokens,
+            "prefill_tokens_saved": sd.prefill_tokens_saved,
+            "prefix_evictions": sd.prefix_evictions,
+            "prefix_nodes": sd.prefix.size if sd.prefix is not None else 0,
+            "tenants": {t: dict(c) for t, c in sorted(sd.tenants.items())},
             "last_tick_s": self.last_tick_s,
             "ticks": self._tick,
         }
@@ -578,7 +677,10 @@ class ServeEngine:
         return label
 
     def close(self) -> None:
-        """Release background resources (watchdog thread, checkpointer)."""
+        """Release background resources (watchdog thread, checkpointer)
+        and drop the prefix cache's block references so
+        ``pool.check_leaks()`` audits clean after shutdown."""
+        self.sched.release_prefix_cache()
         if self._watchdog is not None:
             self._watchdog.close()
         if self._ckpt is not None:
@@ -589,7 +691,8 @@ class ServeEngine:
 
 
 def lint_contracts():
-    """Contracts for the two serving entry programs.
+    """Contracts for the serving entry programs (base decode/prefill
+    pair plus the multi-LoRA decode variant).
 
     Collective-free (strict empty census: the engine is pure SPMD under
     DP/TP sharding — a stray psum would deadlock a replicated server),
@@ -621,27 +724,35 @@ def lint_contracts():
                 tiny_lm_cfg,
             )
 
+            lora = kind == "decode_lora"
             cfg = dataclasses.replace(
                 tiny_lm_cfg(vocab_size=32, max_len=MAXLEN),
-                decode_impl="pallas")
+                decode_impl="pallas",
+                **({"lora_rank": 2, "lora_adapters": 2} if lora else {}))
             fns = build_step_fns(cfg, slots=S, num_blocks=NB,
                                  block_size=BS, prefill_chunk=CH)
-            params = jax.eval_shape(
+            variables = jax.eval_shape(
                 lambda p: fns.model.init(
                     jax.random.PRNGKey(0), p,
                     jnp.zeros((S,), jnp.int32),
                     block_tables=jnp.zeros((S, fns.n_blk), jnp.int32)),
-                jax.ShapeDtypeStruct((S, 1), "int32"))["params"]
+                jax.ShapeDtypeStruct((S, 1), "int32"))
+            params = variables["params"]
             pool = jax.tree.map(
                 lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
                 paged_cache_shapes(fns.cfg, S))
             i32 = "int32"
-            if kind == "decode":
+            if kind.startswith("decode"):
                 args = (params, pool,
                         jax.ShapeDtypeStruct((S, fns.n_blk), i32),
                         jax.ShapeDtypeStruct((S,), i32),
                         jax.ShapeDtypeStruct((S,), i32),
                         jax.ShapeDtypeStruct((S, 2), "uint32"))
+                if lora:
+                    adapters = jax.tree.map(
+                        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
+                        variables["adapters"])
+                    args += (adapters, jax.ShapeDtypeStruct((S,), i32))
                 return fns.decode, args
             args = (params, pool,
                     jax.ShapeDtypeStruct((1, fns.n_blk), i32),
@@ -673,5 +784,11 @@ def lint_contracts():
             name="serve_prefill_chunk_step",
             build=_build("prefill"),
             notes="B=1 chunked prefill through the same attention path",
+            **common),
+        ProgramContract(
+            name="serve_decode_step_lora",
+            build=_build("decode_lora"),
+            notes="multi-adapter decode: gathered low-rank deltas stay "
+                  "collective-free and under the f32 intermediate cap",
             **common),
     ]

@@ -93,14 +93,24 @@ def scatter_chunk(pool, chunk, tables, index, *, block_size: int,
 
 @dataclasses.dataclass
 class BlockPool:
-    """Host-side block allocator: free-list + ownership ledger.
+    """Host-side block allocator: free-list + refcounted holder ledger.
 
     ``num_blocks`` includes the reserved trash block (the LAST id), which
     is never handed out — ``capacity`` is what requests can actually own.
     Deterministic: blocks are allocated lowest-id-first, so an identical
     request trace produces identical tables (the scheduler-determinism
-    test pins this).  The ownership ledger makes aliasing structurally
-    impossible: every alloc records an owner, every free checks it.
+    test pins this).
+
+    Prefix sharing (PR 12) turns the per-block owner into a SET of
+    holders: :meth:`alloc` creates a block with one holder, :meth:`share`
+    ref-bumps an already-live block for a new holder (a request claiming
+    a cached prefix, or the prefix index itself pinning a finished
+    prefill's blocks), and :meth:`free` removes one holder — the block
+    returns to the free list only when its refcount hits zero.  The
+    ledger still makes aliasing structurally impossible: every free
+    checks the caller actually holds the block, and a holder can never
+    be added twice.  ``live_blocks`` counts DISTINCT live blocks, which
+    is what makes the paged byte model charge a shared block once.
     """
 
     num_blocks: int
@@ -111,7 +121,7 @@ class BlockPool:
             raise ValueError("need >= 2 blocks (one is the trash block)")
         self._free: list[int] = sorted(range(self.num_blocks - 1),
                                        reverse=True)
-        self._owner: dict[int, int] = {}  # block id -> request id
+        self._holders: dict[int, set[int]] = {}  # block id -> holder rids
 
     @property
     def trash_block(self) -> int:
@@ -126,39 +136,75 @@ class BlockPool:
         return len(self._free)
 
     def live_blocks(self) -> int:
-        return len(self._owner)
+        """DISTINCT live blocks — a block with N holders counts once."""
+        return len(self._holders)
+
+    def refcount(self, block: int) -> int:
+        return len(self._holders.get(block, ()))
 
     def owned_by(self, rid: int) -> list[int]:
-        return sorted(b for b, o in self._owner.items() if o == rid)
+        return sorted(b for b, h in self._holders.items() if rid in h)
 
     def alloc(self, rid: int, n: int) -> list[int] | None:
-        """``n`` blocks for request ``rid``, lowest ids first — or None
-        (and no state change) when the pool cannot satisfy it."""
+        """``n`` fresh blocks for request ``rid``, lowest ids first — or
+        None (and no state change) when the pool cannot satisfy it."""
         if n > len(self._free):
             return None
         got = [self._free.pop() for _ in range(n)]
         for b in got:
-            self._owner[b] = rid
+            self._holders[b] = {rid}
         return got
 
-    def free(self, rid: int, blocks: list[int]) -> None:
+    def share(self, rid: int, blocks: list[int]) -> None:
+        """Ref-bump live ``blocks`` for holder ``rid`` (the COW claim: a
+        new request adopts a cached prefix without copying anything —
+        the first write it would need into a shared block never happens,
+        because the scheduler only shares FULL prompt blocks and routes
+        every later write into privately allocated blocks)."""
         for b in blocks:
-            if self._owner.get(b) != rid:
+            holders = self._holders.get(b)
+            if holders is None:
+                raise ValueError(
+                    f"request {rid} sharing dead block {b}")
+            if rid in holders:
+                raise ValueError(
+                    f"request {rid} already holds block {b}")
+        for b in blocks:
+            self._holders[b].add(rid)
+
+    def free(self, rid: int, blocks: list[int]) -> None:
+        """Drop ``rid``'s hold on ``blocks``; a block is recycled only
+        when its last holder lets go (refcount 0)."""
+        for b in blocks:
+            if rid not in self._holders.get(b, ()):
                 raise ValueError(
                     f"request {rid} freeing block {b} it does not own "
-                    f"(owner: {self._owner.get(b)})")
-            del self._owner[b]
-            self._free.append(b)
-        self._free.sort(reverse=True)
+                    f"(holders: {sorted(self._holders.get(b, ()))})")
+        released = False
+        for b in blocks:
+            holders = self._holders[b]
+            holders.discard(rid)
+            if not holders:
+                del self._holders[b]
+                self._free.append(b)
+                released = True
+        if released:
+            self._free.sort(reverse=True)
 
     def check_leaks(self) -> None:
-        """Every block accounted for exactly once (the accounting test)."""
-        if len(self._free) + len(self._owner) != self.capacity:
+        """Every block accounted for exactly once (the accounting test):
+        free + distinct-live == capacity, nothing both free and live,
+        and no live block with an empty holder set (a refcount leak)."""
+        if len(self._free) + len(self._holders) != self.capacity:
             raise AssertionError(
                 f"block leak: {len(self._free)} free + "
-                f"{len(self._owner)} owned != {self.capacity}")
-        if set(self._free) & set(self._owner):
+                f"{len(self._holders)} owned != {self.capacity}")
+        if set(self._free) & set(self._holders):
             raise AssertionError("block aliased free AND owned")
+        empty = [b for b, h in self._holders.items() if not h]
+        if empty:
+            raise AssertionError(
+                f"refcount leak: live blocks with no holder: {empty}")
 
 
 def blocks_for(tokens: int, block_size: int) -> int:

@@ -30,17 +30,37 @@ resident streams.  :meth:`snapshot_state`/:meth:`restore_state`
 serialize every live request as a *continuation* — the exact transform
 ``_preempt`` applies — which is why engine restore re-prefills and
 still lands on the same streams bitwise.
+
+Prefix sharing & tenancy (PR 12): with ``prefix_cache=True`` admission
+consults the radix :class:`~.prefix_index.PrefixIndex` and CLAIMS the
+longest cached prefix by ref-bump (``pool.share``) instead of
+re-prefilling it — the claim is capped to a multiple of
+``lcm(block_size, prefill_chunk)`` strictly below the prompt length, so
+the suffix prefill starts chunk-aligned, at least one prompt token is
+always recomputed (the final sample needs a live chunk), and every
+subsequent write (suffix chunks, pads, decode) lands in privately
+allocated blocks — shared blocks are never written, which is the whole
+copy-on-write discipline.  When the pool runs dry, LRU leaf-first trie
+eviction is tried BEFORE preemption.  Requests carry a ``tenant`` id:
+admission becomes deficit-round-robin across the per-tenant queue heads
+(exactly head-of-line FIFO when one tenant is present) under optional
+per-tenant slot/block quotas, so one tenant's burst cannot starve
+another.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import numpy as np
 
 from distributed_tensorflow_guide_tpu.serve.paged_cache import (
     BlockPool,
     blocks_for,
+)
+from distributed_tensorflow_guide_tpu.serve.prefix_index import (
+    PrefixIndex,
 )
 
 PREFILL, DECODE = "prefill", "decode"
@@ -76,6 +96,8 @@ class Request:
     arrival: float = 0.0
     ttft_deadline_s: float | None = None
     deadline_s: float | None = None
+    tenant: int = 0  # fair-share / quota accounting unit
+    adapter: int = 0  # LoRA adapter id (0 = base model)
 
 
 @dataclasses.dataclass
@@ -91,6 +113,10 @@ class _Slot:
     pending: int = 0  # last sampled token (k/v not yet written)
     emitted_here: int = 0  # tokens emitted during THIS residency
     admitted_seq: int = 0
+    tenant: int = 0
+    adapter: int = 0
+    prefix_len: int = 0  # cache positions claimed from the prefix index
+    max_blocks: int = 0  # worst-case footprint (quota commitment)
 
 
 class Scheduler:
@@ -98,7 +124,10 @@ class Scheduler:
 
     def __init__(self, *, slots: int, num_blocks: int, block_size: int,
                  prefill_chunk: int, max_len: int,
-                 max_queue: int | None = None) -> None:
+                 max_queue: int | None = None,
+                 prefix_cache: bool = False,
+                 tenant_quotas: dict[int, dict] | None = None,
+                 drr_quantum: int | None = None) -> None:
         if max_len % prefill_chunk:
             raise ValueError(
                 f"prefill_chunk {prefill_chunk} must divide max_len "
@@ -106,6 +135,8 @@ class Scheduler:
         if max_len % block_size:
             raise ValueError(
                 f"block_size {block_size} must divide max_len {max_len}")
+        if drr_quantum is not None and drr_quantum < 1:
+            raise ValueError(f"drr_quantum must be >= 1, got {drr_quantum}")
         self.slots: list[_Slot | None] = [None] * slots
         self.pool = BlockPool(num_blocks, block_size)
         self.block_size = block_size
@@ -127,6 +158,33 @@ class Scheduler:
         self.shed = 0
         self.cancelled = 0
         self.expired = 0
+        # prefix sharing & tenancy (PR 12)
+        self.prefix: PrefixIndex | None = (
+            PrefixIndex(block_size) if prefix_cache else None)
+        # claim granularity: a claim must be BOTH block-aligned (whole
+        # shared blocks) and chunk-aligned (the suffix prefill starts on
+        # a chunk boundary), and strictly below the prompt length (the
+        # final chunk's sample must come from a live program)
+        self._claim_g = math.lcm(block_size, prefill_chunk)
+        # tenant -> {"slots": int|None, "blocks": int|None}
+        self.tenant_quotas = {int(t): dict(q) for t, q in
+                              (tenant_quotas or {}).items()}
+        # deficit-round-robin: quantum defaults to the worst-case request
+        # footprint, which makes single-tenant admission EXACTLY the
+        # legacy head-of-line FIFO (the deficit gate can never block)
+        self.drr_quantum = (self.blocks_per_seq if drr_quantum is None
+                            else int(drr_quantum))
+        self._deficit: dict[int, int] = {}
+        self.tenant_of: dict[int, int] = {}  # rid -> tenant
+        self.tenants: dict[int, dict[str, int]] = {}
+        self.prefix_hit_tokens = 0
+        self.prefill_tokens_saved = 0
+        self.prefix_evictions = 0
+
+    def _tc(self, tenant: int) -> dict[str, int]:
+        return self.tenants.setdefault(int(tenant), {
+            "submitted": 0, "admitted": 0, "tokens": 0, "done": 0,
+            "shed": 0, "cancelled": 0, "expired": 0, "preempted": 0})
 
     # ---- intake ----------------------------------------------------------
 
@@ -139,18 +197,27 @@ class Scheduler:
         P = int(len(req.prompt))
         if P < 1:
             raise ValueError("empty prompt")
+        if req.tenant < 0:
+            raise ValueError(f"tenant must be >= 0, got {req.tenant}")
+        if req.adapter < 0:
+            raise ValueError(f"adapter must be >= 0, got {req.adapter}")
         if P + req.max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {P} + max_new {req.max_new_tokens} exceeds "
                 f"max_len {self.max_len}")
-        if self.max_request_blocks(P, req.max_new_tokens) > \
-                self.pool.capacity:
+        need = self.max_request_blocks(P, req.max_new_tokens)
+        if need > self.pool.capacity:
             raise ValueError(
                 f"request {req.rid} can never fit: needs "
-                f"{self.max_request_blocks(P, req.max_new_tokens)} blocks, "
-                f"pool capacity {self.pool.capacity}")
+                f"{need} blocks, pool capacity {self.pool.capacity}")
+        quota = self.tenant_quotas.get(int(req.tenant), {})
+        if quota.get("blocks") is not None and need > quota["blocks"]:
+            raise ValueError(
+                f"request {req.rid} can never fit tenant {req.tenant}'s "
+                f"block quota: needs {need}, quota {quota['blocks']}")
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
             self.shed += 1
+            self._tc(req.tenant)["shed"] += 1
             raise EngineOverloaded(
                 f"request {req.rid} shed: queue depth {len(self.queue)} at "
                 f"the max_queue={self.max_queue} gate — retry later "
@@ -158,6 +225,8 @@ class Scheduler:
         self.queue.append(req)
         self.emitted.setdefault(req.rid, [])
         self.first_emit.setdefault(req.rid, False)
+        self.tenant_of.setdefault(req.rid, int(req.tenant))
+        self._tc(req.tenant)["submitted"] += 1
         # the request's lifecycle clock: original arrival + deadlines.
         # Continuations re-enter via queue.insert (not submit), so this
         # records exactly once per rid and deadline checks always measure
@@ -168,30 +237,135 @@ class Scheduler:
     # ---- admission -------------------------------------------------------
 
     def admit(self, now: float) -> list[int]:
-        """FIFO head-of-line admission: fill empty slots with arrived
-        requests whose prefill footprint fits the pool right now. Strict
-        FIFO (no reordering past the head) keeps admission latency fair
-        and the trace deterministic."""
-        admitted = []
-        while self.queue and None in self.slots:
-            req = self.queue[0]
-            if req.arrival > now:
+        """Deficit-round-robin admission over per-tenant queue heads.
+
+        Each round visits every tenant with a queued head (in queue
+        order — continuations at the front keep their priority), credits
+        its deficit with ``drr_quantum`` blocks, and admits the head when
+        the deficit covers the request's worst-case footprint, the
+        tenant's quotas allow it, and the pool can supply the blocks
+        (after claiming any cached prefix — see :meth:`_claim_blocks`).
+        Rounds repeat while some candidate is blocked ONLY by its
+        deficit; the call returns when a round admits nobody else.
+
+        Within a tenant this is strict head-of-line FIFO (no reordering
+        past the head), and with a single tenant and the default quantum
+        (= ``blocks_per_seq`` >= any request's cost) the deficit gate
+        never blocks — admission order, slot choice and block ids are
+        EXACTLY the legacy FIFO loop's, which is what keeps every PR-10/11
+        determinism pin intact."""
+        admitted: list[int] = []
+        while None in self.slots:
+            progressed = False
+            deficit_waiting = False
+            for req, tenant in self._tenant_heads():
+                if None not in self.slots:
+                    break
+                if req.arrival > now:
+                    continue
+                if not self._quota_allows(tenant, req):
+                    continue
+                cost = self.max_request_blocks(len(req.prompt),
+                                               req.max_new_tokens)
+                self._deficit[tenant] = (self._deficit.get(tenant, 0)
+                                         + self.drr_quantum)
+                if self._deficit[tenant] < cost:
+                    deficit_waiting = True
+                    continue
+                claim = self._claim_blocks(req)
+                if claim is None:
+                    continue
+                blocks, prefix_len = claim
+                # remove by IDENTITY: dataclass equality would compare
+                # numpy prompt arrays elementwise
+                self.queue.pop(next(
+                    i for i, r in enumerate(self.queue) if r is req))
+                s = self.slots.index(None)
+                self.slots[s] = _Slot(
+                    rid=req.rid, prompt=np.asarray(req.prompt, np.int32),
+                    budget=req.max_new_tokens, rng=req.rng, blocks=blocks,
+                    chunk_cursor=prefix_len // self.prefill_chunk,
+                    written=prefix_len, admitted_seq=self._seq,
+                    tenant=int(req.tenant), adapter=int(req.adapter),
+                    prefix_len=prefix_len, max_blocks=cost)
+                self._seq += 1
+                self._deficit[tenant] -= cost
+                self._tc(tenant)["admitted"] += 1
+                if prefix_len:
+                    self.prefix_hit_tokens += prefix_len
+                    self.prefill_tokens_saved += prefix_len
+                admitted.append(s)
+                progressed = True
+            if not progressed and not deficit_waiting:
                 break
-            P = len(req.prompt)
-            padded = -(-P // self.prefill_chunk) * self.prefill_chunk
-            blocks = self.pool.alloc(req.rid, blocks_for(padded,
-                                                         self.block_size))
-            if blocks is None:
-                break
-            self.queue.pop(0)
-            s = self.slots.index(None)
-            self.slots[s] = _Slot(
-                rid=req.rid, prompt=np.asarray(req.prompt, np.int32),
-                budget=req.max_new_tokens, rng=req.rng, blocks=blocks,
-                admitted_seq=self._seq)
-            self._seq += 1
-            admitted.append(s)
+        # standard DRR reset: a tenant with nothing queued carries no credit
+        queued_tenants = {int(r.tenant) for r in self.queue}
+        for t in [t for t in self._deficit if t not in queued_tenants]:
+            del self._deficit[t]
         return admitted
+
+    def _tenant_heads(self) -> list[tuple[Request, int]]:
+        """(head request, tenant) per tenant, in queue-front order — the
+        deterministic round order (continuations at the front go first)."""
+        heads: list[tuple[Request, int]] = []
+        seen: set[int] = set()
+        for req in self.queue:
+            t = int(req.tenant)
+            if t not in seen:
+                seen.add(t)
+                heads.append((req, t))
+        return heads
+
+    def _quota_allows(self, tenant: int, req: Request) -> bool:
+        """Slot/block quota check against COMMITTED usage (worst-case
+        footprints of residents), so a quota can never be overrun later
+        by decode growth. A blocked tenant is SKIPPED for the round —
+        never head-of-line blocking for other tenants."""
+        quota = self.tenant_quotas.get(tenant)
+        if not quota:
+            return True
+        mine = [s for s in self.slots
+                if s is not None and s.tenant == tenant]
+        if quota.get("slots") is not None and len(mine) >= quota["slots"]:
+            return False
+        if quota.get("blocks") is not None:
+            committed = sum(s.max_blocks for s in mine)
+            cost = self.max_request_blocks(len(req.prompt),
+                                           req.max_new_tokens)
+            if committed + cost > quota["blocks"]:
+                return False
+        return True
+
+    def _claim_blocks(self, req: Request) -> tuple[list[int], int] | None:
+        """The request's admission blocks: cached-prefix blocks claimed by
+        ref-bump first (prefix cache on), then fresh blocks for the rest
+        of the padded prompt footprint — trying LRU leaf eviction before
+        giving up when the pool is dry.  Returns ``(blocks, prefix_len)``
+        or None (no state change) when the blocks cannot be found.  The
+        claim is ref-bumped BEFORE the fresh alloc so eviction can never
+        free a block the claim is standing on."""
+        P = len(req.prompt)
+        padded = -(-P // self.prefill_chunk) * self.prefill_chunk
+        need = blocks_for(padded, self.block_size)
+        shared: list[int] = []
+        prefix_len = 0
+        if self.prefix is not None:
+            hit = self.prefix.match(req.prompt, adapter=int(req.adapter))
+            cap = ((P - 1) // self._claim_g) * self._claim_g
+            prefix_len = min(len(hit) * self.block_size, cap)
+            shared = hit[:prefix_len // self.block_size]
+            if shared:
+                self.pool.share(req.rid, shared)
+        fresh = self.pool.alloc(req.rid, need - len(shared))
+        while (fresh is None and self.prefix is not None
+               and self.prefix.evict_one(self.pool) is not None):
+            self.prefix_evictions += 1
+            fresh = self.pool.alloc(req.rid, need - len(shared))
+        if fresh is None:
+            if shared:
+                self.pool.free(req.rid, shared)
+            return None
+        return shared + fresh, prefix_len
 
     # ---- tick planning ---------------------------------------------------
 
@@ -226,8 +400,10 @@ class Scheduler:
 
     def _grow_for_decode(self, decodes: list[int]) -> list[int]:
         """Every decoding slot must own the block its next write lands in;
-        grow by one block where needed, preempting the youngest other
-        resident when the pool is dry."""
+        grow by one block where needed. When the pool is dry the prefix
+        cache (if on) gives up LRU leaves FIRST — dropping cold cached
+        suffixes nobody holds — and only then is the youngest other
+        resident preempted (the prefix-off behavior, unchanged)."""
         ready = []
         for i in list(decodes):
             slot = self.slots[i]
@@ -237,6 +413,10 @@ class Scheduler:
                 got = self.pool.alloc(slot.rid, 1)
                 if got is not None:
                     slot.blocks.extend(got)
+                    continue
+                if (self.prefix is not None
+                        and self.prefix.evict_one(self.pool) is not None):
+                    self.prefix_evictions += 1
                     continue
                 victim = self._pick_victim(exclude=i)
                 if victim is None:
@@ -278,9 +458,11 @@ class Scheduler:
         self.queue.insert(0, Request(
             rid=slot.rid, prompt=cont_prompt,
             max_new_tokens=slot.budget, rng=slot.rng,
-            arrival=float("-inf")))
+            arrival=float("-inf"),
+            tenant=slot.tenant, adapter=slot.adapter))
         self.slots[i] = None
         self.preemptions += 1
+        self._tc(slot.tenant)["preempted"] += 1
 
     # ---- result application ---------------------------------------------
 
@@ -302,6 +484,17 @@ class Scheduler:
         s.written = len(s.prompt)
         s.phase = DECODE
         s.pending = int(token)
+        if self.prefix is not None:
+            # cache the FULL prompt blocks (all their positions hold true
+            # prompt KV, written by deterministic chunk-aligned prefill —
+            # bitwise what any token-identical prompt would compute);
+            # existing nodes win, new nodes ref-bump for the cache
+            n_full = len(s.prompt) // self.block_size
+            if n_full:
+                self.prefix.insert(
+                    s.prompt[:n_full * self.block_size],
+                    s.blocks[:n_full], adapter=int(s.adapter),
+                    pool=self.pool)
         return self._emit(slot_idx, int(token))
 
     def apply_decode(self, slot_idx: int, token: int) -> list[tuple]:
@@ -318,12 +511,14 @@ class Scheduler:
         self.first_emit[rid] = True
         s.budget -= 1
         s.emitted_here += 1
+        self._tc(s.tenant)["tokens"] += 1
         done = s.budget == 0
         if done:
             self.pool.free(rid, s.blocks)
             self.slots[slot_idx] = None
             self.done.add(rid)
             self.finished[rid] = "done"
+            self._tc(s.tenant)["done"] += 1
         return [(rid, token, first, done)]
 
     # ---- lifecycle: cancellation, deadlines (PR 11) ----------------------
@@ -385,7 +580,18 @@ class Scheduler:
             self.cancelled += 1
         else:
             self.expired += 1
+        self._tc(self.tenant_of.get(rid, 0))[status] += 1
         return (rid, -1, False, True, status)
+
+    # ---- prefix cache management -----------------------------------------
+
+    def release_prefix_cache(self) -> int:
+        """Drop the whole trie and release its block holds (engine close;
+        also what makes ``check_leaks`` meaningful at shutdown). Returns
+        the number of blocks released."""
+        if self.prefix is None:
+            return 0
+        return self.prefix.drop(self.pool)
 
     # ---- snapshot / restore (PR 11) --------------------------------------
 
@@ -413,6 +619,8 @@ class Scheduler:
                 "budget": int(s.budget),
                 "rng": [int(x) for x in np.asarray(s.rng).ravel()],
                 "arrival": float("-inf"),  # already served once
+                "tenant": int(s.tenant),
+                "adapter": int(s.adapter),
             })
         for r in self.queue:
             requests.append({
@@ -421,6 +629,8 @@ class Scheduler:
                 "budget": int(r.max_new_tokens),
                 "rng": [int(x) for x in np.asarray(r.rng).ravel()],
                 "arrival": float(r.arrival),
+                "tenant": int(r.tenant),
+                "adapter": int(r.adapter),
             })
         return {
             "requests": requests,
@@ -436,7 +646,18 @@ class Scheduler:
                          "preemptions": self.preemptions,
                          "shed": self.shed,
                          "cancelled": self.cancelled,
-                         "expired": self.expired},
+                         "expired": self.expired,
+                         "prefix_hit_tokens": self.prefix_hit_tokens,
+                         "prefill_tokens_saved": self.prefill_tokens_saved,
+                         "prefix_evictions": self.prefix_evictions},
+            "tenant_of": {str(k): int(v)
+                          for k, v in self.tenant_of.items()},
+            "tenants": {str(k): dict(v)
+                        for k, v in self.tenants.items()},
+            # the prefix trie is deliberately NOT captured: it is host
+            # state derived from token ids + deterministic prefills, and
+            # the restoring engine's pool is zeroed — the trie rebuilds
+            # itself as continuations re-prefill (bitwise-identical KV)
         }
 
     def restore_state(self, snap: dict) -> None:
@@ -453,7 +674,9 @@ class Scheduler:
                     prompt=np.asarray(r["prompt"], np.int32),
                     max_new_tokens=int(r["budget"]),
                     rng=np.asarray(r["rng"], np.uint32),
-                    arrival=float(r["arrival"]))
+                    arrival=float(r["arrival"]),
+                    tenant=int(r.get("tenant", 0)),
+                    adapter=int(r.get("adapter", 0)))
             for r in snap["requests"]
         ]
         self.emitted = {int(k): [int(t) for t in v]
@@ -475,6 +698,13 @@ class Scheduler:
         self.shed = int(c["shed"])
         self.cancelled = int(c["cancelled"])
         self.expired = int(c["expired"])
+        self.prefix_hit_tokens = int(c.get("prefix_hit_tokens", 0))
+        self.prefill_tokens_saved = int(c.get("prefill_tokens_saved", 0))
+        self.prefix_evictions = int(c.get("prefix_evictions", 0))
+        self.tenant_of = {int(k): int(v)
+                          for k, v in snap.get("tenant_of", {}).items()}
+        self.tenants = {int(k): {kk: int(vv) for kk, vv in v.items()}
+                        for k, v in snap.get("tenants", {}).items()}
 
     # ---- introspection ---------------------------------------------------
 

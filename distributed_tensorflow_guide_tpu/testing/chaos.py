@@ -118,11 +118,23 @@ class Fault:
     kind: str
     position: int
     param: float = 0.0
+    # arrival_burst only: direct the burst at one tenant so fair-share
+    # admission (not just queue shedding) is what absorbs it
+    tenant: int | None = None
 
     def __post_init__(self):
         if self.kind not in KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r} "
                              f"(choose from {KINDS})")
+        if self.tenant is not None:
+            if self.kind != "arrival_burst":
+                raise ValueError(
+                    f"tenant= only applies to arrival_burst, not "
+                    f"{self.kind!r}")
+            if self.tenant != int(self.tenant) or self.tenant < 0:
+                raise ValueError(
+                    f"arrival_burst tenant must be a non-negative id, "
+                    f"got {self.tenant!r}")
         if self.kind in WORLD_KINDS:
             # param targets the process group: the slice index
             if self.param != int(self.param) or self.param < 0:
@@ -281,15 +293,20 @@ class FaultSchedule:
                      kinds: Sequence[str] = SERVE_STORM_KINDS,
                      n_faults: int = 4, min_position: int = 1,
                      burst_n: int = 2, pressure_blocks: int = 4,
-                     abandon_span: int = 4) -> "FaultSchedule":
+                     abandon_span: int = 4,
+                     burst_tenants: int | None = None) -> "FaultSchedule":
         """Deterministic-in-``seed`` serving storm: ``n_faults`` distinct
         engine-tick positions in ``[min_position, max_position)``, kinds
         drawn uniformly from ``kinds`` (defaults to the storm kinds — the
         snapshot kinds need ``ServeEngine(snapshot_dir=...)``, so pass
         ``SERVE_KINDS`` explicitly to include them). Params: bursts are
         ``burst_n`` requests, pressure spikes grab ``pressure_blocks``,
-        abandons index the live rids in ``[0, abandon_span)``. Same seed
-        → identical schedule, always."""
+        abandons index the live rids in ``[0, abandon_span)``. With
+        ``burst_tenants`` set, each arrival_burst additionally targets a
+        tenant drawn from ``[0, burst_tenants)`` (rng draws happen only
+        for burst faults, so schedules without bursts — or with
+        ``burst_tenants=None`` — are byte-identical to pre-tenancy ones).
+        Same seed → identical schedule, always."""
         bad = [k for k in kinds if k not in SERVE_KINDS]
         if bad:
             raise ValueError(f"non-serve kinds in random_serve: {bad}")
@@ -310,10 +327,18 @@ class FaultSchedule:
                       rng.randint(0, abandon_span)),
                   "arrival_burst": lambda: float(burst_n),
                   "pool_pressure": lambda: float(pressure_blocks)}
-        return cls([
-            Fault(kinds[int(k)], int(p), params[kinds[int(k)]]())
-            for p, k in zip(positions, chosen)
-        ])
+
+        def _tenant(kind):
+            if kind != "arrival_burst" or burst_tenants is None:
+                return None
+            return int(rng.randint(0, burst_tenants))
+
+        faults = []
+        for p, k in zip(positions, chosen):
+            kind = kinds[int(k)]
+            faults.append(Fault(kind, int(p), params[kind](),
+                                tenant=_tenant(kind)))
+        return cls(faults)
 
     @property
     def pending(self) -> list[Fault]:

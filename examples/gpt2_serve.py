@@ -59,6 +59,10 @@ def main() -> None:
     ap.add_argument("--block-size", type=int, default=8)
     ap.add_argument("--num-blocks", type=int, default=17)
     ap.add_argument("--prefill-chunk", type=int, default=8)
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="cache full prompt blocks in the radix prefix "
+                         "index — repeated prompt prefixes skip their "
+                         "prefill (watch prefill_tokens_saved in health)")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -154,7 +158,8 @@ def main() -> None:
                       num_blocks=args.num_blocks,
                       block_size=args.block_size,
                       prefill_chunk=args.prefill_chunk,
-                      temperature=args.temperature, top_k=args.top_k)
+                      temperature=args.temperature, top_k=args.top_k,
+                      prefix_cache=args.prefix_cache)
     prompts = [p.strip() for p in args.prompts.split("|") if p.strip()]
     encoded = {}
     for rid, text in enumerate(prompts):

@@ -268,7 +268,7 @@ def test_block_pool_accounting():
     assert pool.alloc(3, 1) == [0]  # freed blocks recycle lowest-first
     pool.check_leaks()
     # a leaked block is caught
-    del pool._owner[0]
+    del pool._holders[0]
     with pytest.raises(AssertionError, match="leak"):
         pool.check_leaks()
     with pytest.raises(ValueError, match=">= 2 blocks"):
@@ -703,6 +703,387 @@ def test_snapshot_restore_across_decode_levers(params, kv, impl, tmp_path):
             f"req {i} kv={kv} impl={impl}"
     eng2.sched.pool.check_leaks()
     eng2.close()
+
+
+# ---- prefix sharing, tenancy, multi-LoRA (PR 12) ----------------------------
+# The engine tests here reuse the geometries compiled above (the
+# build_step_fns memo) wherever possible; the only new compiles are the
+# tiny LoRA config's step pair and its one-shot oracle.
+
+
+def test_block_pool_refcount_share():
+    """Refcounted sharing: a full block may be claimed by ref-bump, every
+    holder frees independently, the block returns to the free list only
+    at refcount zero, and live_blocks() counts DISTINCT blocks (the dedup
+    closed form the byte model charges)."""
+    pool = BlockPool(6, 8)
+    assert pool.alloc(1, 3) == [0, 1, 2]
+    pool.share(2, [0, 1])                 # rid 2 claims rid 1's prefix
+    assert pool.refcount(0) == 2 and pool.refcount(2) == 1
+    assert pool.owned_by(2) == [0, 1]
+    # 3 + 2 claimed block-refs, but only 3 distinct live blocks
+    assert pool.live_blocks() == 3 and pool.free_blocks == 2
+    pool.check_leaks()
+    with pytest.raises(ValueError, match="already holds"):
+        pool.share(2, [0])                # no double-claim by one holder
+    with pytest.raises(ValueError, match="dead block"):
+        pool.share(3, [4])                # only live blocks are shareable
+    pool.free(1, [0, 1, 2])               # rid 1 exits; rid 2's refs hold
+    assert pool.live_blocks() == 2 and pool.refcount(0) == 1
+    assert pool.alloc(5, 4) is None       # 0,1 are NOT free: only 2,3,4
+    assert pool.alloc(5, 3) == [2, 3, 4]
+    pool.free(2, [0, 1])                  # last holder: now they recycle
+    assert pool.alloc(5, 2) == [0, 1]
+    pool.free(5, [0, 1, 2, 3, 4])
+    assert pool.live_blocks() == 0
+    pool.check_leaks()
+
+
+def test_prefix_index_match_insert_evict():
+    """The radix trie over a real pool: block-granularity match, existing
+    -node-wins insert, LRU leaf-first eviction that never touches a block
+    a resident still holds, and adapter keying."""
+    from distributed_tensorflow_guide_tpu.serve.prefix_index import (
+        CACHE_RID,
+        PrefixIndex,
+    )
+
+    pool = BlockPool(8, 4)
+    idx = PrefixIndex(4)
+    toks = list(range(10))                # 2 full blocks + a partial
+    blocks = pool.alloc(0, 3)
+    assert idx.insert(toks, blocks, pool=pool) == 2   # partial never cached
+    assert idx.size == 2 and pool.refcount(blocks[0]) == 2
+    assert idx.match(toks) == blocks[:2]
+    assert idx.match(toks[:7]) == blocks[:1]          # 1 full block only
+    assert idx.match([9, 9, 9, 9]) == []
+    assert idx.match(toks, adapter=1) == []           # adapter-keyed root
+    # existing node wins: a concurrent duplicate's blocks are not cached
+    dup = pool.alloc(1, 2)
+    assert idx.insert(toks[:8], dup, pool=pool) == 0
+    assert idx.match(toks) == blocks[:2]
+    pool.free(1, dup)
+    # the request exits; the cache's refs keep both blocks live
+    pool.free(0, blocks)
+    assert pool.live_blocks() == 2
+    # eviction is leaf-first: node 1 (deeper) goes before node 0 even
+    # though node 0 is colder — an inner node is never evictable
+    assert idx.evict_one(pool) == blocks[1]
+    assert idx.match(toks) == blocks[:1]
+    # a resident's ref pins the survivor: nothing evictable
+    pool.share(7, [blocks[0]])
+    assert idx.evict_one(pool) is None
+    pool.free(7, [blocks[0]])
+    assert idx.evict_one(pool) == blocks[0]
+    assert idx.size == 0 and pool.live_blocks() == 0
+    pool.check_leaks()
+    # drop releases everything at once (engine close)
+    b2 = pool.alloc(3, 2)
+    idx.insert(list(range(8)), b2, pool=pool)
+    pool.free(3, b2)
+    assert idx.drop(pool) == 2
+    pool.check_leaks()
+    assert pool.refcount(0) == 0 and CACHE_RID < 0
+
+
+def test_prefix_sharing_bitwise_and_dedup(params):
+    """The tentpole pin: with the prefix cache on, a repeat prompt claims
+    its cached blocks by ref-bump and prefills only the suffix — and the
+    stream stays bitwise identical to the same request served ALONE with
+    the cache off. A diverging suffix (COW fork) also stays bitwise: the
+    shared blocks are read-only, private blocks take every write."""
+    fork = np.array([1] * 16 + [2], np.int32)   # shares 2 blocks with
+    prompts = [PROMPTS[2], fork]                # PROMPTS[2] = [1]*17
+    kw = dict(slots=2, num_blocks=33, block_size=8, prefill_chunk=8,
+              temperature=0.8, top_k=10)
+    eng = ServeEngine(CFG, params, prefix_cache=True, **kw)
+    eng.submit(Request(rid=0, prompt=PROMPTS[2], max_new_tokens=MAX_NEW[2],
+                       rng=jax.random.PRNGKey(102)))
+    eng.run()
+    warm_prefills = eng.steps["prefill"]        # 17 tokens -> 3 chunks
+    assert eng.health()["prefix_nodes"] == 2    # [1]*8 twice, cached
+    # repeat + COW fork, served concurrently off the shared prefix
+    eng.submit(Request(rid=1, prompt=PROMPTS[2], max_new_tokens=MAX_NEW[2],
+                       rng=jax.random.PRNGKey(102)))
+    eng.submit(Request(rid=2, prompt=fork, max_new_tokens=MAX_NEW[2],
+                       rng=jax.random.PRNGKey(100)))
+    eng.run()
+    got = eng.completions()
+    # both claimed 16 tokens; each prefilled exactly 1 suffix chunk
+    assert eng.steps["prefill"] == warm_prefills + 2
+    assert eng.health()["prefill_tokens_saved"] == 32
+    assert eng.health()["prefix_hit_tokens"] == 32
+    # bitwise: repeat == the cache-off oracle of the SAME request alone
+    assert got[1] == got[0] == _oracle(CFG, params, 2, 0.8, 10)
+    assert got[2] == _oracle(CFG, params, 0, 0.8, 10,
+                             prompts=[fork], max_new=[MAX_NEW[2]])
+    eng.close()                                 # drops the cache's refs
+    eng.sched.pool.check_leaks()
+    assert eng.live_blocks() == 0
+
+
+@pytest.mark.parametrize("kv,impl", [("int8", "dense"), (None, "pallas")])
+def test_prefix_sharing_parity_across_decode_levers(params, kv, impl):
+    """Prefix claims compose with the decode levers: the repeat request
+    reads its shared blocks through the int8/pallas read path (scale
+    blocks ride the same block ids) and still reproduces the cache-off
+    one-shot stream bitwise. Same geometry as
+    test_engine_parity_across_decode_levers — no new compiles."""
+    cfg = dataclasses.replace(CFG, kv_dtype=kv, decode_impl=impl)
+    eng = ServeEngine(cfg, params, prefix_cache=True, slots=2,
+                      num_blocks=17, block_size=8, prefill_chunk=8,
+                      temperature=0.8, top_k=10)
+    eng.submit(Request(rid=0, prompt=PROMPTS[1], max_new_tokens=MAX_NEW[1],
+                       rng=jax.random.PRNGKey(101)))
+    eng.run()
+    assert eng.health()["prefix_nodes"] == 1    # one full block cached
+    eng.submit(Request(rid=1, prompt=PROMPTS[1], max_new_tokens=MAX_NEW[1],
+                       rng=jax.random.PRNGKey(101)))
+    eng.run()
+    assert eng.health()["prefill_tokens_saved"] == 8
+    got = eng.completions()
+    assert got[0] == got[1] == _oracle(cfg, params, 1, 0.8, 10), \
+        f"kv={kv} impl={impl}"
+    eng.close()
+    eng.sched.pool.check_leaks()
+
+
+def test_prefix_dedup_charges_shared_blocks_once(params):
+    """live_blocks() closed form while shared prefixes are RESIDENT: two
+    claimers of a 2-block prefix plus their private suffixes count the
+    shared blocks once — the paged byte model's denominator."""
+    kw = dict(slots=2, num_blocks=33, block_size=8, prefill_chunk=8,
+              temperature=0.0, top_k=None)
+    eng = ServeEngine(CFG, params, prefix_cache=True, **kw)
+    eng.submit(Request(rid=0, prompt=PROMPTS[2], max_new_tokens=MAX_NEW[2],
+                       rng=jax.random.PRNGKey(102)))
+    eng.run()
+    eng.submit(Request(rid=1, prompt=PROMPTS[2], max_new_tokens=MAX_NEW[2],
+                       rng=jax.random.PRNGKey(102)))
+    eng.submit(Request(rid=2, prompt=PROMPTS[2], max_new_tokens=MAX_NEW[2],
+                       rng=jax.random.PRNGKey(102)))
+    eng.step()  # both admitted: shared prefix claimed, suffixes private
+    pool = eng.sched.pool
+    # the prompt needs 3 blocks: 2 shared (also the cache's 2) + 1
+    # private tail each => 4 distinct live blocks, not 6 — the shared
+    # pair is charged once
+    assert pool.owned_by(1)[:2] == pool.owned_by(2)[:2]
+    assert pool.live_blocks() == 4
+    assert sum(len(pool.owned_by(r)) for r in (1, 2)) == 6
+    eng.run()
+    assert eng.completions()[1] == eng.completions()[2] \
+        == _oracle(CFG, params, 2, 0.0, None)
+    eng.close()
+    eng.sched.pool.check_leaks()
+
+
+def test_prefix_eviction_and_preemption_parity(params):
+    """The tight pool (nb=9) with the cache on: cached blocks are evicted
+    LRU leaf-first to feed decode growth BEFORE any resident is
+    preempted, and every stream still lands bitwise. Prompts span 2 full
+    blocks each so finishing really populates the trie."""
+    prompts = [np.array([1] * 17, np.int32),
+               np.array([2] * 17, np.int32),
+               np.array([3] * 17, np.int32)]
+    max_new = [30, 30, 30]
+    eng = ServeEngine(CFG, params, slots=2, num_blocks=9, block_size=8,
+                      prefill_chunk=8, temperature=0.7, top_k=12,
+                      prefix_cache=True)
+    _submit_all(eng, prompts=prompts[:2], max_new=max_new[:2])
+    eng.run()
+    # the finished prompts (and their preempted continuations) now fill
+    # the trie; a cold third prompt must evict cached leaves to fit
+    assert eng.health()["prefix_nodes"] >= 4
+    eng.submit(Request(rid=2, prompt=prompts[2], max_new_tokens=max_new[2],
+                       rng=jax.random.PRNGKey(102)))
+    eng.run()
+    assert eng.sched.prefix_evictions >= 1  # the cache yielded to decode
+    got = eng.completions()
+    for i in range(3):
+        assert got[i] == _oracle(CFG, params, i, 0.7, 12, prompts=prompts,
+                                 max_new=max_new), f"req {i}"
+    eng.close()
+    eng.sched.pool.check_leaks()
+    assert eng.live_blocks() == 0
+
+
+def test_scheduler_drr_interleaves_and_quotas_skip(params):
+    """Host-side fair share: with a small quantum, deficit round-robin
+    interleaves a backlogged tenant with a light one instead of FIFO
+    head-of-line; a quota-blocked tenant is SKIPPED (never blocks the
+    others); with the default quantum admission IS legacy FIFO."""
+    key = np.asarray(jax.random.PRNGKey(0))
+    p = np.array([1, 2, 3, 4, 5], np.int32)
+
+    def mk(rid, tenant):
+        return Request(rid=rid, prompt=p, max_new_tokens=8, rng=key,
+                       tenant=tenant)
+
+    # cost = blocks_for(5+8) = 2; quantum 1 -> every admit costs 2 rounds
+    sch = Scheduler(slots=4, num_blocks=33, block_size=8, prefill_chunk=8,
+                    max_len=64, drr_quantum=1)
+    for r in [mk(0, 0), mk(1, 0), mk(2, 0), mk(3, 1)]:
+        sch.submit(r)
+    sch.admit(0.0)
+    order = [s.rid for s in sorted(
+        (s for s in sch.slots if s is not None),
+        key=lambda s: s.admitted_seq)]
+    assert order == [0, 3, 1, 2]  # tenant 1 jumps the tenant-0 backlog
+    assert sch.tenants[0]["admitted"] == 3 and sch.tenants[1]["admitted"] == 1
+    # a single tenant reduces to exact head-of-line FIFO (the PR-10/11
+    # determinism pins above run through this same path unchanged)
+    sch2 = Scheduler(slots=4, num_blocks=33, block_size=8, prefill_chunk=8,
+                     max_len=64)
+    for r in [mk(0, 0), mk(1, 0), mk(2, 0)]:
+        sch2.submit(r)
+    sch2.admit(0.0)
+    order2 = [s.rid for s in sorted(
+        (s for s in sch2.slots if s is not None),
+        key=lambda s: s.admitted_seq)]
+    assert order2 == [0, 1, 2]
+    # a slots quota caps tenant 0 at 1 resident and SKIPS its backlog
+    sch3 = Scheduler(slots=2, num_blocks=33, block_size=8, prefill_chunk=8,
+                     max_len=64, tenant_quotas={0: {"slots": 1}})
+    for r in [mk(0, 0), mk(1, 0), mk(2, 1)]:
+        sch3.submit(r)
+    sch3.admit(0.0)
+    resident = {s.rid: s.tenant for s in sch3.slots if s is not None}
+    assert resident == {0: 0, 2: 1}       # rid 1 waits; rid 2 not blocked
+    assert [r.rid for r in sch3.queue] == [1]
+    # a blocks quota below a request's worst-case footprint can NEVER be
+    # satisfied — that is a caller error, rejected loudly at submit
+    sch4 = Scheduler(slots=4, num_blocks=33, block_size=8, prefill_chunk=8,
+                     max_len=64, tenant_quotas={0: {"blocks": 1}})
+    with pytest.raises(ValueError, match="never fit"):
+        sch4.submit(mk(9, 0))             # needs 2 blocks, quota caps at 1
+
+
+def test_fair_share_absorbs_tenant_burst(params):
+    """A chaos arrival_burst aimed at one tenant, with that tenant under
+    a slots quota: the victim tenant's streams are untouched bitwise and
+    the per-tenant health counters account for every burst request."""
+    def burst(n, now, tenant):
+        assert tenant == 0
+        return [Request(rid=1000 + k, prompt=PROMPTS[0], max_new_tokens=4,
+                        rng=jax.random.PRNGKey(42), arrival=now,
+                        tenant=tenant) for k in range(n)]
+
+    sched = FaultSchedule([Fault("arrival_burst", 3, 2.0, tenant=0)])
+    eng = ServeEngine(CFG, params, slots=2, num_blocks=33, block_size=8,
+                      prefill_chunk=8, temperature=0.8, top_k=10,
+                      chaos=sched, burst_factory=burst,
+                      tenant_quotas={0: {"slots": 1}})
+    for i, (p, mn) in enumerate(zip(PROMPTS, MAX_NEW)):
+        eng.submit(Request(rid=i, prompt=p, max_new_tokens=mn,
+                           rng=jax.random.PRNGKey(100 + i), tenant=1))
+    eng.run()
+    assert sched.serve_events() == []
+    got = eng.completions()
+    for i in range(len(PROMPTS)):  # tenant 1: bitwise despite the burst
+        assert got[i] == _oracle(CFG, params, i, 0.8, 10), f"req {i}"
+    gen = make_generate_fn(CFG, max_new_tokens=4, temperature=0.8,
+                           top_k=10)
+    out = np.asarray(gen(params, PROMPTS[0][None],
+                         jax.random.PRNGKey(42)))[0, len(PROMPTS[0]):]
+    for rid in (1000, 1001):  # burst requests also land bitwise
+        assert got[rid] == out.tolist()
+    t = eng.health()["tenants"]
+    assert t[0]["submitted"] == 2 and t[0]["done"] == 2
+    assert t[1]["submitted"] == 3 and t[1]["done"] == 3
+    eng.close()
+    eng.sched.pool.check_leaks()
+
+
+def test_multi_lora_batched_decode_bitwise(params):
+    """Batched multi-LoRA: one shared decode step serves slots on
+    different adapters via gathered low-rank deltas. Adapter 0 (the zero
+    rows) is bitwise the BASE model; adapter k is bitwise the one-shot
+    generate with that adapter's delta applied."""
+    from distributed_tensorflow_guide_tpu.serve.engine import (
+        init_adapter_bank,
+    )
+
+    cfg_l = dataclasses.replace(CFG, lora_rank=2, lora_adapters=2)
+    bank = init_adapter_bank(cfg_l)
+    keys = jax.random.split(jax.random.PRNGKey(7), len(jax.tree.leaves(bank)))
+    bank = jax.tree.unflatten(
+        jax.tree.structure(bank),
+        [0.05 * jax.random.normal(k, l.shape, l.dtype).at[0].set(0.0)
+         for k, l in zip(keys, jax.tree.leaves(bank))])
+    eng = ServeEngine(cfg_l, params, slots=2, num_blocks=33, block_size=8,
+                      prefill_chunk=8, temperature=0.8, top_k=10,
+                      adapters=bank)
+    eng.submit(Request(rid=0, prompt=PROMPTS[0], max_new_tokens=MAX_NEW[0],
+                       rng=jax.random.PRNGKey(100), adapter=0))
+    eng.submit(Request(rid=1, prompt=PROMPTS[1], max_new_tokens=MAX_NEW[1],
+                       rng=jax.random.PRNGKey(101), adapter=1))
+    eng.run()
+    got = eng.completions()
+    # adapter 0 == the base oracle, bitwise, even batched WITH adapter 1
+    assert got[0] == _oracle(CFG, params, 0, 0.8, 10)
+    gen1 = make_generate_fn(cfg_l, max_new_tokens=MAX_NEW[1],
+                            temperature=0.8, top_k=10, adapters=bank,
+                            adapter_id=1)
+    o1 = np.asarray(gen1(params, PROMPTS[1][None],
+                         jax.random.PRNGKey(101)))[0,
+                                                   len(PROMPTS[1]):].tolist()
+    assert got[1] == o1 and o1 != _oracle(CFG, params, 1, 0.8, 10)
+    eng.close()
+    eng.sched.pool.check_leaks()
+
+
+def test_snapshot_restore_rebuilds_prefix_cache(params, tmp_path):
+    """Kill+restore with sharing live: the trie is deliberately NOT in
+    the snapshot — the restored engine's continuation re-prefills rebuild
+    it deterministically, streams stay bitwise, and a post-restore repeat
+    prompt hits the rebuilt cache."""
+    kw = dict(slots=2, num_blocks=33, block_size=8, prefill_chunk=8,
+              temperature=0.8, top_k=10, prefix_cache=True,
+              snapshot_dir=str(tmp_path / "snap"))
+    eng = ServeEngine(CFG, params, **kw)
+    _submit_all(eng)
+    for _ in range(7):
+        eng.step()
+    label = eng.save_snapshot()
+    assert label is not None
+    for _ in range(3):
+        eng.step()
+    eng.close()  # the kill: cache refs dropped, post-snapshot work lost
+
+    eng2 = ServeEngine(CFG, params, **kw)
+    assert eng2.restore_latest_snapshot() == label
+    eng2.run()
+    got = eng2.completions()
+    for i in range(len(PROMPTS)):
+        assert got[i] == _oracle(CFG, params, i, 0.8, 10), f"req {i}"
+    # the rebuilt trie serves a repeat of the longest prompt from cache
+    assert eng2.health()["prefix_nodes"] >= 2
+    eng2.submit(Request(rid=9, prompt=PROMPTS[2],
+                        max_new_tokens=MAX_NEW[2],
+                        rng=jax.random.PRNGKey(102)))
+    eng2.run()
+    assert eng2.completions()[9] == _oracle(CFG, params, 2, 0.8, 10)
+    assert eng2.health()["prefill_tokens_saved"] >= 16
+    eng2.close()
+    eng2.sched.pool.check_leaks()
+    assert eng2.live_blocks() == 0
+
+
+def test_tenant_adapter_submit_validation(params):
+    eng = ServeEngine(CFG, params, slots=2, num_blocks=33, block_size=8,
+                      prefill_chunk=8)
+    with pytest.raises(ValueError, match="no lora_rank"):
+        eng.submit(Request(rid=0, prompt=PROMPTS[0], max_new_tokens=4,
+                           rng=jax.random.PRNGKey(0), adapter=1))
+    with pytest.raises(ValueError, match="tenant"):
+        eng.submit(Request(rid=1, prompt=PROMPTS[0], max_new_tokens=4,
+                           rng=jax.random.PRNGKey(0), tenant=-1))
+    with pytest.raises(ValueError, match="adapters"):
+        ServeEngine(CFG, params, slots=2, num_blocks=33, block_size=8,
+                    prefill_chunk=8, adapters={"x": jnp.zeros((1,))})
+    with pytest.raises(ValueError, match="drr_quantum"):
+        Scheduler(slots=2, num_blocks=9, block_size=8, prefill_chunk=8,
+                  max_len=64, drr_quantum=0)
 
 
 # ---- kill mid-snapshot, across real process boundaries (out of tier-1) ------
