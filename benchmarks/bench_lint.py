@@ -4,12 +4,19 @@
 This is the subprocess that makes ``dtg-lint`` part of tier-1: it forces
 the pinned 8-fake-CPU-device geometry, traces EVERY registered
 :class:`~distributed_tensorflow_guide_tpu.analysis.contracts.ProgramContract`
-(12 programs as of round 12 — the serve family carries three: base
-decode step, prefill-chunk step, and the gathered multi-LoRA decode
-step) and runs all five rule families — exactly what the standalone CLI
-does — then emits the one-line JSON contract. ``value`` is the number of
-clean programs; rc is 1 if any program violates its contract, so a lint
-regression fails the smoke suite (and tests/test_benchmarks.py) loudly.
+(13 programs as of round 17 — the serve family carries three, and the
+Switch-MoE train step joined with the cost auditor) and runs all six
+rule families — exactly what the standalone CLI does — then emits the
+one-line JSON contract. ``value`` is the number of clean programs; rc is
+1 if any program violates its contract OR any fingerprint drifts from
+``analysis/golden_fingerprints.json`` without a bless, so both a lint
+regression and silent trace drift fail the smoke suite (and
+tests/test_benchmarks.py) loudly.
+
+``--cost`` additionally prints the derived cost table (MXU FLOPs, HBM
+bytes, per-axis collective bytes, peak live bytes per program) to
+stderr and reports ``cost_programs_pass`` — how many programs' CostSpec
+pins all held against the benchmarks/common.py closed forms.
 
 Lint is trace-time only (nothing compiles, nothing executes), so this is
 a liveness + wall-clock check, not a throughput number: ``lint_seconds``
@@ -30,6 +37,9 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--fake-devices", type=int, default=8,
                     help="virtual CPU devices (contracts are pinned at 8)")
+    ap.add_argument("--cost", action="store_true",
+                    help="print the derived cost table and report the "
+                         "CostSpec pin pass count")
     ap.add_argument("--small", action="store_true",
                     help="accepted for smoke-suite parity (lint programs "
                          "are already toy-scale; no-op)")
@@ -43,10 +53,15 @@ def main() -> int:
     dt = time.perf_counter() - t0
     if not rep.ok:
         print(lint.render_text(rep), file=sys.stderr)
+    if args.cost:
+        print(lint.render_cost_table(rep), file=sys.stderr)
     report("lint_programs_pass", float(sum(p.ok for p in rep.programs)),
            "programs",
            n_programs=len(rep.programs),
            n_findings=rep.n_findings,
+           cost_programs_pass=rep.n_cost_pass,
+           fingerprints_clean=not rep.fingerprint_drift,
+           n_fingerprint_drift=len(rep.fingerprint_drift),
            lint_seconds=round(dt, 2))
     return 0 if rep.ok else 1
 

@@ -27,7 +27,6 @@ def main() -> None:
     device_setup(args.fake_devices)
     import jax
     import jax.numpy as jnp
-    import numpy as np
     import optax
     from flax.training import train_state
 

@@ -328,13 +328,43 @@ def device_dcn_peak() -> float | None:
 # retransmits, push the measured fraction DOWN, which is the signal.
 
 
+# Every closed form below also exposes a ``*_terms`` breakdown — the same
+# number split into its algorithmic components — and computes its total AS
+# the sum of those terms, so the headline model and its breakdown can never
+# diverge. The cost auditor (analysis/cost.py) diffs its derived per-axis
+# collective bytes against these term-by-term; a drifted model is a lint
+# failure, not a stale doc.
+
+
+def dp_allreduce_terms(grad_bytes: float, world: int) -> dict:
+    """Ring all-reduce split into its two one-way passes (each moves
+    (n−1)/n of the buffer per device)."""
+    if world <= 1:
+        return {"reduce_scatter": 0.0, "all_gather": 0.0}
+    frac = (world - 1) / world
+    return {"reduce_scatter": grad_bytes * frac,
+            "all_gather": grad_bytes * frac}
+
+
 def dp_allreduce_bytes(grad_bytes: float, world: int) -> float:
     """Sync-DP gradient all-reduce: ring = reduce-scatter + all-gather,
     each moving (n−1)/n of the buffer per device — 2·P·(n−1)/n. Zero on a
     1-device axis (lax.pmean compiles to a no-op there)."""
+    return sum(dp_allreduce_terms(grad_bytes, world).values())
+
+
+def fsdp_comm_terms(sharded_param_bytes: float, world: int,
+                    replicated_grad_bytes: float = 0.0) -> dict:
+    """ZeRO-3 traffic split: the forward param all-gather, the backward
+    grad reduce-scatter (one one-way pass each over the sharded leaves),
+    and the plain 2-pass all-reduce the replicated leaves still pay."""
     if world <= 1:
-        return 0.0
-    return 2.0 * grad_bytes * (world - 1) / world
+        return {"param_all_gather": 0.0, "grad_reduce_scatter": 0.0,
+                "replicated_grad_allreduce": 0.0}
+    frac = (world - 1) / world
+    return {"param_all_gather": sharded_param_bytes * frac,
+            "grad_reduce_scatter": sharded_param_bytes * frac,
+            "replicated_grad_allreduce": 2.0 * replicated_grad_bytes * frac}
 
 
 def fsdp_comm_bytes(sharded_param_bytes: float, world: int,
@@ -348,11 +378,18 @@ def fsdp_comm_bytes(sharded_param_bytes: float, world: int,
     (n−1)/n each; replicated leaves' gradients still pay the plain 2-pass
     all-reduce. Pinned against the traced schedule (one all_gather + one
     reduce_scatter per sharded leaf) in tests/test_overlap.py."""
-    if world <= 1:
-        return 0.0
-    frac = (world - 1) / world
-    return (2.0 * sharded_param_bytes
-            + 2.0 * replicated_grad_bytes) * frac
+    return sum(fsdp_comm_terms(sharded_param_bytes, world,
+                               replicated_grad_bytes).values())
+
+
+def pipeline_ppermute_terms(act_bytes: float, num_microbatches: int,
+                            stages: int) -> dict:
+    """Pipeline traffic split into the forward activation hops and the
+    backward activation-gradient hops (M·act·(P−1)/P each)."""
+    if stages <= 1:
+        return {"fwd_activations": 0.0, "bwd_activation_grads": 0.0}
+    one_way = num_microbatches * act_bytes * (stages - 1) / stages
+    return {"fwd_activations": one_way, "bwd_activation_grads": one_way}
 
 
 def pipeline_ppermute_bytes(act_bytes: float, num_microbatches: int,
@@ -362,9 +399,33 @@ def pipeline_ppermute_bytes(act_bytes: float, num_microbatches: int,
     2·M·act·(P−1)/P per device, ring-averaged (the P-th hop is the wrap
     that carries no payload). Matches
     ``PipelinedLM.ppermute_bytes_per_step`` (pinned)."""
-    if stages <= 1:
+    return sum(pipeline_ppermute_terms(
+        act_bytes, num_microbatches, stages).values())
+
+
+def outer_sync_terms(float_state_bytes: float, n_slices: int) -> dict:
+    """Outer DCN ring all-reduce split into its two one-way passes."""
+    if n_slices <= 1:
+        return {"reduce_scatter": 0.0, "all_gather": 0.0}
+    frac = (n_slices - 1) / n_slices
+    return {"reduce_scatter": float_state_bytes * frac,
+            "all_gather": float_state_bytes * frac}
+
+
+def moe_all_to_all_bytes(dispatch_buffer_bytes: float,
+                         expert_world: int,
+                         n_layers: int = 1) -> float:
+    """Expert-parallel routing traffic per device per step: each MoE layer
+    crosses the expert axis four times — dispatch + return in the forward,
+    the same pair again for the gradients in the backward — each an
+    all_to_all keeping the local 1/e share, so 4·L·B·(e−1)/e where B is
+    the per-device dispatch buffer (e_global · capacity · d_model ·
+    itemsize; ``parallel/expert.py`` sizes capacity as
+    ceil(top_k · t_local · capacity_factor / e_global))."""
+    if expert_world <= 1:
         return 0.0
-    return 2.0 * num_microbatches * act_bytes * (stages - 1) / stages
+    return (4.0 * n_layers * dispatch_buffer_bytes
+            * (expert_world - 1) / expert_world)
 
 
 def outer_sync_bytes(float_state_bytes: float, n_slices: int) -> float:
@@ -376,9 +437,7 @@ def outer_sync_bytes(float_state_bytes: float, n_slices: int) -> float:
     state bytes (``MultiSliceLocalSGD.outer_float_bytes``). Zero at one
     slice (the pmean compiles to a no-op). Divide by ``sync_period``
     inner steps for the amortized per-step DCN load."""
-    if n_slices <= 1:
-        return 0.0
-    return 2.0 * float_state_bytes * (n_slices - 1) / n_slices
+    return sum(outer_sync_terms(float_state_bytes, n_slices).values())
 
 
 def dcn_extras(comm_bytes: float, comm_secs: float | None = None,
@@ -532,16 +591,74 @@ def loss_bytes_model(batch: int, seq: int, vocab: int, d_model: int, *,
     Like every roofline model here this is MINIMAL algorithmic traffic —
     spills push the measured fraction down, which is the tuning signal.
     """
+    return sum(loss_bytes_terms(
+        batch, seq, vocab, d_model, chunk=chunk, act_bytes=act_bytes,
+        param_bytes=param_bytes).values())
+
+
+def loss_bytes_terms(batch: int, seq: int, vocab: int, d_model: int, *,
+                     chunk: int | None = None, act_bytes: int = 2,
+                     param_bytes: int = 4) -> dict:
+    """:func:`loss_bytes_model` split into its traffic components (the
+    naive path's dominant term — seven (N, V) f32 logit passes — gets its
+    own key so the auditor can point at exactly what the fused path
+    deletes)."""
     n = batch * (seq - 1)
     x_bytes = n * d_model * act_bytes
     w_bytes = d_model * vocab * param_bytes
-    dw_bytes = d_model * vocab * 4  # f32 grad
-    common = 2 * w_bytes + x_bytes + x_bytes + dw_bytes  # W fwd+bwd, x, dx out
+    terms = {
+        "w_read_fwd_bwd": 2.0 * w_bytes,     # W read fwd + by the dx matmul
+        "x_read_fwd": float(x_bytes),
+        "dx_write": float(x_bytes),
+        "dw_write": float(d_model * vocab * 4),  # f32 grad out
+    }
     if chunk is None or chunk >= vocab:
-        return common + 7.0 * n * vocab * 4
-    # fused: +1 x read and +1 W read for the bwd recompute; per-chunk f32
-    # tiles stay on chip
-    return common + x_bytes + w_bytes
+        terms["logit_passes"] = 7.0 * n * vocab * 4
+    else:
+        # fused: +1 x read and +1 W read for the bwd recompute; per-chunk
+        # f32 tiles stay on chip
+        terms["x_read_recompute"] = float(x_bytes)
+        terms["w_read_recompute"] = float(w_bytes)
+    return terms
+
+
+def fused_ce_trace_terms(n_rows: int, d_model: int, vocab: int, chunk: int,
+                         *, act_bytes: int = 2, param_bytes: int = 2,
+                         accum_bytes: int = 4) -> dict:
+    """Fusion-BOUNDARY traffic of the fused-CE value_and_grad trace — the
+    model the static cost auditor pins, NOT the VMEM-ideal
+    :func:`loss_bytes_model`. The auditor charges every chunk matmul's
+    operands and f32 accumulator at the HBM boundary (it cannot see XLA
+    keeping a score tile resident), so per chunk it counts: the forward
+    logit dot, the target-logit gather, and three backward dots (forward
+    recompute, dx, dW). The gap between this and ``loss_bytes_model`` is
+    exactly the VMEM-residency benefit the fused-CE tuner chases."""
+    n_chunks = -(-vocab // chunk)
+    x = n_rows * d_model * act_bytes          # activations, compute dtype
+    w_c = d_model * chunk * param_bytes       # one weight chunk
+    dz_c = n_rows * chunk * act_bytes         # score-grad chunk, cast down
+    score_c = n_rows * chunk * accum_bytes    # f32 score tile
+    return {
+        "fwd_dot_read": float(n_chunks * (x + w_c)),
+        "fwd_dot_write": float(n_chunks * score_c),
+        "target_gather": float(n_chunks * 2 * n_rows * accum_bytes),
+        "bwd_recompute_read": float(n_chunks * (x + w_c)),
+        "bwd_recompute_write": float(n_chunks * score_c),
+        "dx_dot_read": float(n_chunks * (dz_c + w_c)),
+        "dx_dot_write": float(n_chunks * n_rows * d_model * accum_bytes),
+        "dw_dot_read": float(n_chunks * (x + dz_c)),
+        "dw_dot_write": float(n_chunks * d_model * chunk * accum_bytes),
+    }
+
+
+def fused_ce_trace_bytes(n_rows: int, d_model: int, vocab: int, chunk: int,
+                         *, act_bytes: int = 2, param_bytes: int = 2,
+                         accum_bytes: int = 4) -> float:
+    """Sum of :func:`fused_ce_trace_terms` — the ``hbm_bytes`` pin of the
+    ``fused_ce_loss_grad`` program contract."""
+    return sum(fused_ce_trace_terms(
+        n_rows, d_model, vocab, chunk, act_bytes=act_bytes,
+        param_bytes=param_bytes, accum_bytes=accum_bytes).values())
 
 
 def mfu_extras(model_flops_per_step: float, steps: int, dt: float,

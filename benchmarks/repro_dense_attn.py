@@ -35,7 +35,6 @@ def try_case(case: str, seq: int, remat: bool, layers: int,
     import dataclasses
 
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from distributed_tensorflow_guide_tpu.models.transformer import (

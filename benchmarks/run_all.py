@@ -140,8 +140,11 @@ SMOKE = {
     "bench_lint.py":
         # NOT a liveness stub either: lint is trace-time only, so the
         # smoke run IS the full registry audit at the pinned 8-device
-        # geometry — this line is what puts dtg-lint inside tier-1
-        ["--fake-devices", "8"],
+        # geometry — this line is what puts dtg-lint inside tier-1.
+        # --cost arms the derived-cost pins (CostSpec vs the
+        # benchmarks/common.py closed forms) and the golden-fingerprint
+        # drift gate in the same pass
+        ["--fake-devices", "8", "--cost"],
 }
 
 

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import subprocess
 import sys
 import time
@@ -249,6 +248,12 @@ BATTERY: list[tuple[str, list[str], int]] = [
     ("native_input", ["benchmarks/bench_native_input.py"], 1200),
     ("resnet_native_input",
      ["benchmarks/bench_resnet_native_input.py"], 1800),
+    # static program audit (PR 13): trace-time only, so the battery row
+    # is the same full-registry run as the tier-1 smoke — it rides along
+    # so every on-chip capture also records the cost table and the
+    # fingerprint-drift verdict for the exact tree being measured
+    ("lint_cost_audit",
+     ["benchmarks/bench_lint.py", "--fake-devices", "8", "--cost"], 900),
 ]
 
 

@@ -49,6 +49,41 @@ class DonationSpec:
 
 
 @dataclasses.dataclass(frozen=True)
+class CostPin:
+    """One audited quantity: the cost interpreter's derived number must
+    match ``expect`` within ``rel_tol``.
+
+    ``quantity`` is a :meth:`analysis.cost.CostVector.quantity` spelling:
+    a scalar field (``"flops"``, ``"hbm_bytes"``, ``"hbm_bytes_read"``,
+    ``"hbm_bytes_written"``, ``"peak_live_bytes"``,
+    ``"collective_bytes_total"``) or one census-keyed entry spelled
+    ``"collective_bytes[psum[data]]"``.
+
+    ``expect`` is a number or a ZERO-ARG CALLABLE evaluated at rule time —
+    the callable form is the point of the subsystem: providers pass
+    ``lambda: common.dp_allreduce_bytes(...)`` so the pin IS the
+    ``benchmarks/common.py`` closed form, and a drifted byte model fails
+    lint instead of going stale. ``rel_tol=0`` means exact.
+    """
+
+    quantity: str
+    expect: Any
+    rel_tol: float = 0.0
+    note: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class CostSpec:
+    """The contract's quantitative promises: closed-form pins plus an
+    optional hard ceiling on per-device peak live bytes (the linear-scan
+    liveness number — a dead donation or a new whole-program live buffer
+    pushes it up and fails the budget)."""
+
+    pins: tuple[CostPin, ...] = ()
+    max_peak_live_bytes: int | None = None
+
+
+@dataclasses.dataclass(frozen=True)
 class ProgramContract:
     """One judged entry program and everything the rules check it against.
 
@@ -84,6 +119,9 @@ class ProgramContract:
     allowed_callbacks: tuple[str, ...] = ()
     sources: tuple[str, ...] = ()
     notes: str = ""
+    #: quantitative promises (round 17); None = observe-only — the cost
+    #: vector is still derived and fingerprinted, just not pinned.
+    cost: CostSpec | None = None
 
 
 _REGISTRY: dict[str, ProgramContract] = {}

@@ -1,0 +1,139 @@
+"""Golden program fingerprints: the drift gate over registered traces.
+
+A fingerprint is two stable hashes per registered program:
+
+* ``structure`` — sha256 of the *normalized* jaxpr text: the walker's
+  address-normalization (``0x1234abcd`` → ``0x•``) plus var-numbering
+  left intact (jaxpr printing is deterministic per trace), so the hash
+  moves exactly when the traced program's structure moves — a new eqn,
+  a changed shape, a different collective — and never with process
+  ASLR.
+* ``cost`` — the derived :class:`analysis.cost.CostVector`, rounded, so
+  a pure cost-model change (say a new kernel cost model making the same
+  structure price differently) is ALSO a gated change: the blessed
+  numbers are the repo's numbers of record.
+
+Goldens persist to ``analysis/golden_fingerprints.json`` next to this
+module — committed, human-diffable (sorted keys, one program per entry,
+the bless ``reason`` stored inline), no timestamps so re-blessing an
+unchanged registry is a no-op diff. The gate runs inside every default
+``dtg-lint``: a program whose fingerprint differs from its golden — or
+a registered program with no golden at all — is a lint failure until
+``dtg-lint --bless --reason "why"`` rewrites the file. That is the whole
+point: trace drift needs a *stated reason* in the commit that carries
+it, not a reviewer noticing a silent diff.
+
+Import discipline matches the package: no jax at module import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import re
+from pathlib import Path
+
+GOLDEN_PATH = Path(__file__).resolve().parent / "golden_fingerprints.json"
+
+#: Cost entries are rounded to this many significant-ish decimals before
+#: hashing/storing so float formatting can never flap the gate.
+_ROUND = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class Fingerprint:
+    program: str
+    structure: str           # sha256 hex of the normalized jaxpr text
+    cost: dict               # rounded CostVector.to_dict()
+
+    def to_json(self) -> dict:
+        return {"structure": self.structure, "cost": self.cost}
+
+
+def _round(value):
+    if isinstance(value, dict):
+        return {k: _round(v) for k, v in sorted(value.items())}
+    if isinstance(value, float):
+        return round(value, _ROUND)
+    return value
+
+
+_ADDR = re.compile(r"0x[0-9a-f]+")
+
+
+def structure_hash(jaxpr) -> str:
+    """Stable hash of the normalized trace text — the same
+    address-scrubbing normalization as ``walker.traced_text`` (repr'd
+    closures/meshes in eqn params carry object addresses that differ per
+    process, not per program), applied to an already-traced jaxpr."""
+    text = _ADDR.sub("0x•", str(jaxpr))
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def fingerprint(name: str, jaxpr, cost_vector) -> Fingerprint:
+    return Fingerprint(
+        program=name,
+        structure=structure_hash(jaxpr),
+        cost=_round(cost_vector.to_dict()),
+    )
+
+
+# ---- golden store ------------------------------------------------------------
+
+
+def load_goldens(path: Path | None = None) -> dict:
+    """{program: {"structure": ..., "cost": {...}, "reason": ...}} — empty
+    when no golden file exists yet (every program then reports
+    ``missing-golden`` until the first bless)."""
+    p = path or GOLDEN_PATH
+    if not p.exists():
+        return {}
+    return json.loads(p.read_text())
+
+
+def save_goldens(fingerprints: list[Fingerprint], reason: str,
+                 path: Path | None = None) -> Path:
+    """Bless: rewrite the golden file from live fingerprints. ``reason``
+    is stored per program so the blame trail lives in the artifact, not
+    just the commit message."""
+    p = path or GOLDEN_PATH
+    payload = {
+        fp.program: {**fp.to_json(), "reason": reason}
+        for fp in sorted(fingerprints, key=lambda f: f.program)
+    }
+    p.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+    return p
+
+
+def diff_fingerprint(fp: Fingerprint, goldens: dict) -> list[str]:
+    """Human-readable drift lines for one program; [] when clean."""
+    gold = goldens.get(fp.program)
+    if gold is None:
+        return [f"{fp.program}: no golden fingerprint "
+                f"(new program? bless it with --bless --reason)"]
+    out = []
+    if gold.get("structure") != fp.structure:
+        out.append(f"{fp.program}: structure hash drifted "
+                   f"{gold.get('structure', '?')[:12]} -> "
+                   f"{fp.structure[:12]}")
+    gcost, lcost = gold.get("cost", {}), fp.cost
+    for key in sorted(set(gcost) | set(lcost)):
+        if key == "collective_bytes":
+            g, l = gcost.get(key, {}), lcost.get(key, {})
+            for ck in sorted(set(g) | set(l)):
+                if g.get(ck) != l.get(ck):
+                    out.append(f"{fp.program}: cost[{key}[{ck}]] "
+                               f"{g.get(ck)} -> {l.get(ck)}")
+        elif gcost.get(key) != lcost.get(key):
+            out.append(f"{fp.program}: cost[{key}] "
+                       f"{gcost.get(key)} -> {lcost.get(key)}")
+    return out
+
+
+def stale_goldens(live_names: set[str], goldens: dict) -> list[str]:
+    """Goldens for programs that no longer exist (renamed/removed without
+    a bless) — also drift: the registry and the record must agree."""
+    return [f"{name}: golden exists but program is not registered "
+            f"(removed/renamed? re-bless)"
+            for name in sorted(set(goldens) - live_names)]

@@ -11,9 +11,16 @@ or ``--json``) carries the expected-vs-observed diff per finding.
 
 ``--changed-only`` maps ``git diff --name-only <base>`` (plus the working
 tree) onto each contract's ``sources`` so a small edit lints in seconds;
-any edit under ``analysis/`` re-lints everything, and when git state is
-unreadable the mode falls back to the full audit rather than passing
-vacuously.
+any edit under ``analysis/`` — or to ``benchmarks/common.py``, whose
+closed forms the cost pins audit — re-lints everything, and when git
+state is unreadable the mode falls back to the full audit rather than
+passing vacuously.
+
+Round 17 adds the drift gate: every linted program's normalized trace +
+derived cost vector is hashed (``analysis/fingerprint.py``) and compared
+to the blessed ``analysis/golden_fingerprints.json``; an unblessed
+change exits 1. ``--cost`` prints the per-program cost table;
+``--bless --reason "why"`` rewrites the goldens.
 """
 
 from __future__ import annotations
@@ -68,29 +75,43 @@ class ProgramReport:
     rules: list
     error: str | None = None
     notes: str = ""
+    fingerprint: Any = None  # analysis.fingerprint.Fingerprint | None
 
     def to_dict(self) -> dict:
         return {"name": self.name, "ok": self.ok,
                 "rules": [r.to_dict() for r in self.rules],
-                "error": self.error, "notes": self.notes}
+                "error": self.error, "notes": self.notes,
+                "fingerprint": (self.fingerprint.to_json()
+                                if self.fingerprint else None)}
 
 
 @dataclasses.dataclass
 class LintReport:
     programs: list
+    #: fingerprint-vs-golden drift lines (empty = clean); populated by
+    #: check_fingerprints, part of ``ok`` — drift without a bless fails.
+    fingerprint_drift: list = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
-        return all(p.ok for p in self.programs)
+        return (all(p.ok for p in self.programs)
+                and not self.fingerprint_drift)
 
     @property
     def n_findings(self) -> int:
         return sum(len(r.findings) for p in self.programs for r in p.rules)
 
+    @property
+    def n_cost_pass(self) -> int:
+        return sum(1 for p in self.programs for r in p.rules
+                   if r.rule == "cost" and r.ok)
+
     def to_dict(self) -> dict:
         return {"ok": self.ok, "n_programs": len(self.programs),
                 "n_pass": sum(p.ok for p in self.programs),
                 "n_findings": self.n_findings,
+                "n_cost_pass": self.n_cost_pass,
+                "fingerprint_drift": list(self.fingerprint_drift),
                 "programs": [p.to_dict() for p in self.programs]}
 
 
@@ -119,9 +140,15 @@ def lint_contract(contract) -> ProgramReport:
                              error=traceback.format_exc(limit=8),
                              notes=contract.notes)
     reports = [rule(traced, contract) for rule in rules.ALL_RULES]
+    fp = None
+    if traced.cost_vector is not None:  # set by rule_cost
+        from distributed_tensorflow_guide_tpu.analysis import fingerprint
+        fp = fingerprint.fingerprint(
+            contract.name, traced.jaxpr, traced.cost_vector)
     return ProgramReport(contract.name,
                          ok=all(r.ok for r in reports),
-                         rules=reports, notes=contract.notes)
+                         rules=reports, notes=contract.notes,
+                         fingerprint=fp)
 
 
 def run_contracts(contracts) -> LintReport:
@@ -183,6 +210,10 @@ def select_changed(contracts, base: str) -> tuple[list, str]:
     changed_abs = {os.path.basename(c): c for c in changed}
     if any("/analysis/" in c or c.startswith("analysis/") for c in changed):
         return list(contracts), "analysis/ changed -> full lint"
+    # the closed forms under cost audit: an edit there can invalidate any
+    # contract's pins, so it re-lints everything just like analysis/
+    if any(c.endswith("benchmarks/common.py") for c in changed):
+        return list(contracts), "benchmarks/common.py changed -> full lint"
     picked = []
     for c in contracts:
         hit = False
@@ -196,15 +227,100 @@ def select_changed(contracts, base: str) -> tuple[list, str]:
     return picked, f"{len(changed)} changed file(s)"
 
 
+def check_fingerprints(report: LintReport, *, full_registry: bool,
+                       golden_path=None) -> None:
+    """The drift gate: diff every linted program's live fingerprint
+    against the blessed goldens; mismatch / missing-golden lines land in
+    ``report.fingerprint_drift`` (part of ``ok``). Stale goldens — a
+    golden whose program no longer exists — only fail on full-registry
+    runs (a ``--programs`` subset says nothing about the rest)."""
+    from distributed_tensorflow_guide_tpu.analysis import fingerprint
+
+    goldens = fingerprint.load_goldens(golden_path)
+    drift: list[str] = []
+    for p in report.programs:
+        if p.fingerprint is None:
+            continue  # trace error: already a FAIL via p.ok
+        drift.extend(fingerprint.diff_fingerprint(p.fingerprint, goldens))
+    if full_registry:
+        live = {p.name for p in report.programs}
+        drift.extend(fingerprint.stale_goldens(live, goldens))
+    report.fingerprint_drift = drift
+
+
+def bless_fingerprints(report: LintReport, reason: str,
+                       golden_path=None):
+    """Rewrite the goldens from the live fingerprints. Refuses when any
+    rule failed — blessed numbers must come from a clean registry."""
+    from distributed_tensorflow_guide_tpu.analysis import fingerprint
+
+    broken = [p.name for p in report.programs
+              if not p.ok or p.fingerprint is None]
+    if broken:
+        raise RuntimeError(
+            f"refusing to bless with failing/untraceable programs: "
+            f"{broken} — fix the contracts first")
+    return fingerprint.save_goldens(
+        [p.fingerprint for p in report.programs], reason, golden_path)
+
+
 def run_lint(names=None, changed_only: bool = False,
-             base: str = "HEAD") -> LintReport:
+             base: str = "HEAD", fingerprints: bool = True) -> LintReport:
     contracts = _registered(tuple(names) if names else None)
+    full = names is None and not changed_only
     if changed_only:
         contracts, _why = select_changed(contracts, base)
-    return run_contracts(contracts)
+    report = run_contracts(contracts)
+    if fingerprints:
+        check_fingerprints(report, full_registry=full)
+    return report
 
 
 # ---- rendering --------------------------------------------------------------
+
+
+def _fmt_bytes(x: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(x) < 1024 or unit == "GiB":
+            return f"{x:,.1f}{unit}" if unit != "B" else f"{x:,.0f}B"
+        x /= 1024
+    return f"{x:,.1f}GiB"
+
+
+def render_cost_table(report: LintReport) -> str:
+    """The ``--cost`` table: one row per program from the cost rule's
+    observations (present whether or not the contract pins anything)."""
+    rows = [("program", "MXU flops", "HBM read", "HBM write",
+             "collective", "peak live")]
+    for p in report.programs:
+        obs = next((r.observed for r in p.rules if r.rule == "cost"), None)
+        if not obs or "flops" not in obs:
+            rows.append((p.name, "-", "-", "-", "-", "-"))
+            continue
+        coll = sum(obs.get("collective_bytes", {}).values())
+        rows.append((p.name, f"{obs['flops']:,.0f}",
+                     _fmt_bytes(obs["hbm_bytes_read"]),
+                     _fmt_bytes(obs["hbm_bytes_written"]),
+                     _fmt_bytes(coll),
+                     _fmt_bytes(obs["peak_live_bytes"])))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    out = []
+    for i, r in enumerate(rows):
+        out.append("  ".join(
+            c.ljust(w) if j == 0 else c.rjust(w)
+            for j, (c, w) in enumerate(zip(r, widths))))
+        if i == 0:
+            out.append("  ".join("-" * w for w in widths))
+    detail = []
+    for p in report.programs:
+        obs = next((r.observed for r in p.rules if r.rule == "cost"), None)
+        for key, v in sorted((obs or {}).get(
+                "collective_bytes", {}).items()):
+            detail.append(f"    {p.name}: {key} = {_fmt_bytes(v)}")
+    if detail:
+        out.append("  per-axis collective bytes:")
+        out.extend(detail)
+    return "\n".join(out)
 
 
 def render_text(report: LintReport, verbose: bool = False) -> str:
@@ -226,10 +342,15 @@ def render_text(report: LintReport, verbose: bool = False) -> str:
                 lines.append(f"        - {f.message}")
                 lines.append(f"          expected: {f.expected!r}   "
                              f"observed: {f.observed!r}")
+    if report.fingerprint_drift:
+        lines.append("FAIL  golden fingerprints (unblessed trace drift — "
+                     "run dtg-lint --bless --reason '...'):")
+        lines.extend(f"        - {d}" for d in report.fingerprint_drift)
     lines.append(
         f"{'PASS' if report.ok else 'FAIL'}: "
         f"{sum(p.ok for p in report.programs)}/{len(report.programs)} "
-        f"programs clean, {report.n_findings} finding(s)")
+        f"programs clean, {report.n_findings} finding(s), "
+        f"{len(report.fingerprint_drift)} fingerprint drift(s)")
     return "\n".join(lines)
 
 
@@ -252,9 +373,27 @@ def main(argv=None) -> int:
                         help="machine-readable report on stdout")
     parser.add_argument("--list", action="store_true",
                         help="list registered programs and exit")
+    parser.add_argument("--cost", action="store_true",
+                        help="print the derived cost table (FLOPs, HBM "
+                             "bytes, collective bytes, peak live) per "
+                             "program")
+    parser.add_argument("--bless", action="store_true",
+                        help="rewrite analysis/golden_fingerprints.json "
+                             "from the live traces (requires --reason)")
+    parser.add_argument("--reason", default=None,
+                        help="why the fingerprints changed — stored in "
+                             "the golden file; required with --bless")
+    parser.add_argument("--no-fingerprints", action="store_true",
+                        help="skip the golden-fingerprint drift gate")
     parser.add_argument("-v", "--verbose", action="store_true",
                         help="show per-rule observations for passing rules")
     args = parser.parse_args(argv)
+
+    if args.bless and not args.reason:
+        parser.error("--bless requires --reason 'why the traces changed'")
+    if args.bless and (args.programs or args.changed_only):
+        parser.error("--bless rewrites ALL goldens: run it on the full "
+                     "registry (no --programs / --changed-only)")
 
     _ensure_cpu_devices()
     names = args.programs.split(",") if args.programs else None
@@ -263,6 +402,7 @@ def main(argv=None) -> int:
             print(f"{c.name:32} sources={','.join(c.sources)}")
         return 0
     contracts = _registered(tuple(names) if names else None)
+    full = names is None and not args.changed_only
     if args.changed_only:
         contracts, why = select_changed(contracts, args.base)
         if not args.json:
@@ -272,9 +412,23 @@ def main(argv=None) -> int:
             print("nothing to lint")
             return 0
     report = run_contracts(contracts)
+    if args.bless:
+        try:
+            path = bless_fingerprints(report, args.reason)
+        except RuntimeError as e:
+            print(f"BLESS REFUSED: {e}", file=sys.stderr)
+            print(render_text(report, verbose=args.verbose))
+            return 1
+        print(f"blessed {len(report.programs)} fingerprint(s) -> {path}")
+        return 0
+    if not args.no_fingerprints:
+        check_fingerprints(report, full_registry=full)
     if args.json:
         print(json.dumps(report.to_dict()))
     else:
+        if args.cost:
+            print(render_cost_table(report))
+            print()
         print(render_text(report, verbose=args.verbose))
     return 0 if report.ok else 1
 

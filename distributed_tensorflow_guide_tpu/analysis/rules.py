@@ -19,6 +19,11 @@ Rule families (docs/analysis.md has the catalog with rationale):
    once at top level (invar aliasing counted).
 5. **determinism** — no host callbacks / nondeterministic-lowering
    primitives inside step functions.
+6. **cost**      — the abstract cost interpreter (``analysis/cost.py``)
+   derives FLOPs / HBM bytes / per-axis collective bytes / peak live
+   bytes from the trace and diffs them against the contract's
+   :class:`~.contracts.CostSpec` pins — closed-form models from
+   ``benchmarks/common.py``, now machine-checked at trace time.
 """
 
 from __future__ import annotations
@@ -69,6 +74,9 @@ class TracedProgram:
     name: str
     jaxpr: Any  # jax.extend.core.ClosedJaxpr
     arg_leaf_avals: list[list[Any]]
+    #: set by rule_cost as a side effect so the linter can fingerprint
+    #: the program without interpreting the trace twice
+    cost_vector: Any = None
 
 
 # ---- 1. memory --------------------------------------------------------------
@@ -318,6 +326,54 @@ def rule_determinism(traced: TracedProgram,
     return RuleReport("determinism", observed, findings)
 
 
+# ---- 6. cost ----------------------------------------------------------------
+
+
+def rule_cost(traced: TracedProgram,
+              contract: ProgramContract) -> RuleReport:
+    """Derive the program's cost vector and hold it to the contract's
+    :class:`~.contracts.CostSpec`. With no spec the rule is observe-only
+    (the vector still feeds the fingerprint gate); with one, every pin is
+    diffed against its closed-form expectation and the optional peak-live
+    budget is enforced."""
+    from distributed_tensorflow_guide_tpu.analysis import cost as cost_mod
+
+    spec = contract.cost
+    try:
+        vec = cost_mod.program_cost(traced, contract)
+    except Exception as e:  # pragma: no cover - exercised via fake jaxprs
+        # Un-interpretable trace: fine to observe (micro-programs in
+        # tests), fatal when the contract declares pins it can't verify.
+        findings = [] if spec is None else [Finding(
+            "cost", f"cost interpreter failed on the trace: {e!r}",
+            expected="interpretable trace", observed=type(e).__name__)]
+        return RuleReport("cost", {"error": repr(e)}, findings)
+    traced.cost_vector = vec
+    observed = vec.to_dict()
+    if spec is None:
+        return RuleReport("cost", observed, [])
+    findings = []
+    for pin in spec.pins:
+        want = float(pin.expect() if callable(pin.expect) else pin.expect)
+        got = vec.quantity(pin.quantity)
+        if abs(got - want) > pin.rel_tol * max(abs(want), 1.0):
+            findings.append(Finding(
+                "cost",
+                f"{pin.quantity} drifted from the closed-form model"
+                + (f" ({pin.note})" if pin.note else ""),
+                expected=(f"{want:g}" if pin.rel_tol == 0
+                          else f"{want:g} ±{pin.rel_tol:.1%}"),
+                observed=got))
+    cap = spec.max_peak_live_bytes
+    if cap is not None and vec.peak_live_bytes > cap:
+        findings.append(Finding(
+            "cost",
+            f"peak live bytes {vec.peak_live_bytes} over the declared "
+            "per-device budget",
+            expected=f"<= {cap} bytes", observed=vec.peak_live_bytes))
+    return RuleReport("cost", observed, findings)
+
+
 #: Registry the linter iterates — order is the report order.
 ALL_RULES: tuple[Callable[[TracedProgram, ProgramContract], RuleReport],
                  ...] = (
@@ -326,4 +382,5 @@ ALL_RULES: tuple[Callable[[TracedProgram, ProgramContract], RuleReport],
     rule_collectives,
     rule_donation,
     rule_determinism,
+    rule_cost,
 )

@@ -95,6 +95,17 @@ COLLECTIVE_PRIMS = frozenset({
     "ppermute", "pbroadcast", "all_to_all",
 })
 
+#: shard_map's replication-checking rewrite (``check_rep=True``) rebinds
+#: ``psum`` as the distinct ``psum2`` primitive; the data movement is
+#: identical, so censuses and cost pricing spell both as ``psum``.
+_PRIM_ALIASES = {"psum2": "psum"}
+
+
+def prim_name(eqn) -> str:
+    """``eqn``'s primitive name with rewrite aliases normalized."""
+    name = eqn.primitive.name
+    return _PRIM_ALIASES.get(name, name)
+
 
 def eqn_axis_names(eqn) -> tuple[str, ...]:
     """The *named* mesh axes one collective equation reduces over (its
@@ -113,8 +124,9 @@ def collective_census(jaxpr) -> Counter:
     equation census of the final jaxpr is single-valued)."""
     census: Counter = Counter()
     for eqn in walk(jaxpr):
-        if eqn.primitive.name in COLLECTIVE_PRIMS:
-            key = f"{eqn.primitive.name}[{','.join(eqn_axis_names(eqn))}]"
+        name = prim_name(eqn)
+        if name in COLLECTIVE_PRIMS:
+            key = f"{name}[{','.join(eqn_axis_names(eqn))}]"
             census[key] += 1
     return census
 

@@ -25,7 +25,6 @@ import dataclasses
 import logging
 import os
 import subprocess
-import tempfile
 from pathlib import Path
 from typing import Iterator, Mapping, Sequence
 

@@ -456,6 +456,7 @@ def lint_contracts():
     donation exists to let XLA reuse the buffer in place — and the lint
     checks the cache is read exactly once at top level instead."""
     from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        CostSpec,
         DonationSpec,
         ProgramContract,
     )
@@ -505,12 +506,16 @@ def lint_contracts():
             name="decode_step",
             build=build(0),
             donation=DonationSpec(argnums=(2,), mode="scratch"),
+            # 123,596 observed: params + donated KV cache dominate; a
+            # regression that holds a second cache copy live doubles this
+            cost=CostSpec(max_peak_live_bytes=131072),
             notes="vanilla scan decode: cache donated as scratch",
             **common),
         ProgramContract(
             name="decode_spec_step",
             build=build(1),
             donation=DonationSpec(argnums=(2, 3), mode="scratch"),
+            cost=CostSpec(max_peak_live_bytes=196608),
             notes="self-speculative decode (while_loop body audited too)",
             **common),
     ]

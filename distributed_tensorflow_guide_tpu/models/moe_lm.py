@@ -287,3 +287,94 @@ class SwitchLM:
         )
         with self.mesh:
             return jax.jit(tx.init, out_shardings=shardings)(params)
+
+
+# ---- program contracts (analysis/) ------------------------------------------
+
+
+def lint_contracts():
+    """Contract for the Switch train step over the data x expert mesh.
+    The defining expectation is the all_to_all census: exactly 4 eqns on
+    the expert axis — dispatch + return in the forward scan body, their
+    transposes in the backward — and NOTHING else crossing expert as raw
+    token traffic. The cost pin holds the byte side of the same promise:
+    derived all_to_all traffic must equal the comm_bytes_model's
+    4·L·B·(e−1)/e with B the fixed-capacity dispatch buffer."""
+    from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        CostPin,
+        CostSpec,
+        DonationSpec,
+        ProgramContract,
+    )
+    from distributed_tensorflow_guide_tpu.analysis.cost import closed_forms
+
+    # 8-device fixture: data=2 x expert=4, E=4 experts, top_k=1.
+    # t_local = (8 tokens / 8 devices) * max_len 8 = 8 rows per device;
+    # capacity = ceil(1 * 8 * 2.0 / 4) = 4 -> dispatch buffer
+    # (E=4, C=4, d=16) f32 = 1024 B per device (the return buffer
+    # (e_local=1, E*C=16, d=16) is the same 1024 B by construction)
+    n_expert, n_layers, top_k, cap_factor = 4, 2, 1, 2.0
+
+    def _build():
+        import jax
+        import optax
+
+        from distributed_tensorflow_guide_tpu.analysis.fixtures import (
+            tiny_lm_cfg,
+        )
+        from distributed_tensorflow_guide_tpu.core.mesh import (
+            MeshSpec,
+            build_mesh,
+        )
+
+        cfg = tiny_lm_cfg()
+        mesh = build_mesh(MeshSpec(data=2, expert=n_expert))
+        lm = SwitchLM(mesh, cfg, num_experts=n_expert, top_k=top_k,
+                      capacity_factor=cap_factor, fused_ce=False)
+        params = jax.eval_shape(lm.init_params, jax.random.PRNGKey(0))
+        tx = optax.sgd(0.1)
+        opt_state = jax.eval_shape(tx.init, params)
+        step = lm.make_train_step(tx, params, donate=True)
+        tokens = jax.ShapeDtypeStruct((8, 8), "int32")
+        return step, (opt_state, params, tokens)
+
+    def _a2a_expect():
+        t_local, d_model = 8, 16
+        capacity = max(1, -(-top_k * t_local * int(cap_factor) // n_expert))
+        dispatch_bytes = n_expert * capacity * d_model * 4
+        return closed_forms().moe_all_to_all_bytes(
+            dispatch_bytes, n_expert, n_layers=n_layers)
+
+    return [
+        ProgramContract(
+            name="moe_train_step",
+            build=_build,
+            policy="f32",
+            collectives={
+                # dispatch + return per scan body, forward and backward
+                "all_to_all[expert]": 4,
+                # replicated-leaf grad psums (embed/attn/ln2/router/head
+                # trees) + the loss/aux metric pmeans over both token axes
+                "psum[data,expert]": 13,
+                # the two expert-sharded stacks (w_in, w_out) reduce over
+                # data ONLY — their expert contributions arrived through
+                # the backward all_to_all; a psum[data,expert] here would
+                # double-count across experts
+                "psum[data]": 2,
+            },
+            donation=DonationSpec(argnums=(0, 1)),
+            sources=(
+                "distributed_tensorflow_guide_tpu.models.moe_lm",
+                "distributed_tensorflow_guide_tpu.parallel.expert",
+                "distributed_tensorflow_guide_tpu.collectives.collectives",
+            ),
+            cost=CostSpec(
+                pins=(
+                    CostPin("collective_bytes[all_to_all[expert]]",
+                            _a2a_expect,
+                            note="4·L·B·(e-1)/e expert-routing traffic "
+                                 "at the fixed-capacity dispatch buffer"),
+                ),
+                max_peak_live_bytes=262144),
+            notes="Switch-MoE step: tokens travel, expert params stay"),
+    ]

@@ -615,3 +615,53 @@ def make_paged_decode_runner(blk_k: int, *, b: int, h: int, s: int,
 
     f = jax.jit(call)
     return lambda: f(*ops)
+
+
+# --------------------------------------------------------------------------
+# static cost model (analysis/cost.py kernel registry)
+# --------------------------------------------------------------------------
+
+
+def _attn_kernel_cost(eqn):
+    """Cost of one (paged or dense) decode-attention ``pallas_call`` for
+    the static auditor — derived from the equation's grid and BlockSpecs,
+    with the HBM side delegated to :func:`decode_kernel_hbm_bytes` so the
+    auditor and the kernel microbench price the same call identically.
+    The q/out chunk is counted at its lane-PADDED size (the BlockSpec is
+    all the jaxpr knows); the dense static-shape ceiling, like the
+    closed form's default."""
+    gm = eqn.params["grid_mapping"]
+    b, h, n_kv = (int(g) for g in gm.grid)
+    bms = list(gm.block_mappings)
+    _, _, cp, hd = (int(d) for d in bms[0].block_shape)   # q block
+    blk_k = int(bms[1].block_shape[2])                    # k block
+    s = n_kv * blk_k
+    k_aval = eqn.invars[gm.num_index_operands + 1].aval
+    q_aval = eqn.outvars[0].aval
+    total = decode_kernel_hbm_bytes(
+        b=b, h=h, s=s, d=hd, dtype=k_aval.dtype, chunk=cp,
+        q_dtype=q_aval.dtype)
+    import numpy as np
+
+    qo_half = b * h * cp * hd * np.dtype(q_aval.dtype).itemsize
+    return {
+        # qk^T + softmax-weighted pv: two (cp, blk_k, hd) contractions
+        # per grid cell over the full static grid
+        "flops": 4.0 * b * h * s * cp * hd,
+        "read": total - qo_half,
+        "write": float(qo_half),
+    }
+
+
+def _register_kernel_costs():
+    # analysis.cost is jax-free at import; the dependency edge ops ->
+    # analysis is acyclic (analysis never imports ops at module scope)
+    from distributed_tensorflow_guide_tpu.analysis.cost import (
+        register_kernel_cost,
+    )
+
+    register_kernel_cost("_decode_kernel", _attn_kernel_cost)
+    register_kernel_cost("_paged_decode_kernel", _attn_kernel_cost)
+
+
+_register_kernel_costs()

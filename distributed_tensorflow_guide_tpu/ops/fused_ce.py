@@ -253,8 +253,11 @@ def lint_contracts():
     tensor may exist in forward OR backward (the ``vocab_rows=N`` floor
     keeps the legitimate (D, V) weight gradient out of scope)."""
     from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        CostPin,
+        CostSpec,
         ProgramContract,
     )
+    from distributed_tensorflow_guide_tpu.analysis.cost import closed_forms
 
     N, D, V, CHUNK = 64, 32, 128, 32
 
@@ -282,5 +285,21 @@ def lint_contracts():
             max_vocab_f32_elems=0,
             collectives={},  # single-shard: no vocab-parallel psums
             sources=("distributed_tensorflow_guide_tpu.ops.fused_ce",),
+            cost=CostSpec(
+                pins=(
+                    # fwd + bwd-recompute + dx + dW: four logit-matmul
+                    # passes (the 3x-fwd MFU convention counts 3 — the
+                    # extra 1/3 is the chunked recompute, the flop price
+                    # of never materializing logits)
+                    CostPin("flops", 4 * 2.0 * N * D * V,
+                            note="4 logit-matmul passes incl. the fused "
+                                 "backward recompute"),
+                    CostPin("hbm_bytes",
+                            lambda: closed_forms().fused_ce_trace_bytes(
+                                N, D, V, CHUNK),
+                            note="fusion-boundary chunk traffic model "
+                                 "(NOT the VMEM-ideal loss_bytes_model)"),
+                ),
+                max_peak_live_bytes=65536),
             notes="bf16 MXU operands, f32 accumulation, no full logits"),
     ]

@@ -371,12 +371,17 @@ def lint_contracts():
     whose collective count is DERIVED from the bucket partition — N
     buckets must mean exactly N mid-backward grad psums, the structure
     the latency-hiding scheduler needs."""
+    import dataclasses
+
     import numpy as np
 
     from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        CostPin,
+        CostSpec,
         DonationSpec,
         ProgramContract,
     )
+    from distributed_tensorflow_guide_tpu.analysis.cost import closed_forms
     from distributed_tensorflow_guide_tpu.core.mesh import (
         MeshSpec,
         build_mesh,
@@ -403,8 +408,45 @@ def lint_contracts():
                "distributed_tensorflow_guide_tpu.collectives.collectives")
     # the tiny_mlp param tree at bucket_bytes=1: one bucket per leaf
     leaf_shapes = [(16, 32), (32,), (32, 16), (16,)]
+    grad_bytes = sum(int(np.prod(s)) * 4 for s in leaf_shapes)  # 4288
     n_buckets = len(overlap.bucket_assignment(
         [np.zeros(s, np.float32) for s in leaf_shapes], bucket_bytes=1))
+
+    def _grad_allreduce_expect():
+        # grad-tree ring allreduce + the loss and mae scalar metric pmeans
+        import jax
+
+        common = closed_forms()
+        world = jax.device_count()
+        return (common.dp_allreduce_bytes(grad_bytes, world)
+                + 2 * common.dp_allreduce_bytes(4, world))
+
+    def _flops_expect():
+        # the 3x-forward MFU convention counts 6 forward-equivalent
+        # matmuls per step; the real backward of a 2-layer MLP skips the
+        # first layer's input-grad matmul, so the trace holds 5 of them —
+        # and the auditor sees PER-DEVICE shapes inside shard_map
+        import jax
+
+        from distributed_tensorflow_guide_tpu.analysis.fixtures import (
+            tiny_mlp,
+        )
+
+        loss_fn, state, batch = tiny_mlp()
+        common = closed_forms()
+        full = common.model_flops_per_step(loss_fn, state.params, batch)
+        return full / jax.device_count() * 5.0 / 6.0
+
+    dp_cost = CostSpec(
+        pins=(
+            CostPin("collective_bytes[psum[data]]", _grad_allreduce_expect,
+                    note="comm_bytes_model: 2*G*(n-1)/n grad ring + 2 "
+                         "scalar metric pmeans"),
+            CostPin("flops", _flops_expect,
+                    note="5/6 of the 3x-fwd convention (no input-grad "
+                         "matmul at layer 0), per device"),
+        ),
+        max_peak_live_bytes=20480)
     return [
         ProgramContract(
             name="dp_train_step",
@@ -414,6 +456,7 @@ def lint_contracts():
             collectives={"psum[data]": 3},
             donation=DonationSpec(argnums=(0,)),
             sources=sources,
+            cost=dp_cost,
             notes="sync-DP mono step: one gradient collective per step"),
         ProgramContract(
             name="dp_overlap_train_step",
@@ -424,6 +467,10 @@ def lint_contracts():
             collectives={"psum[data]": n_buckets + 2},
             donation=DonationSpec(argnums=(0,)),
             sources=sources,
+            # same bytes as the mono step (bucketing changes WHEN psums
+            # fire, not how much they move); buckets die mid-backward so
+            # the peak sits ~2KiB below the mono step's
+            cost=dataclasses.replace(dp_cost, max_peak_live_bytes=18432),
             notes=f"bucketed backward: {n_buckets} buckets -> "
                   f"{n_buckets} grad psums"),
     ]

@@ -206,13 +206,34 @@ def lint_contracts():
     budget GSPMD used to infer (counts derived from the fixture's leaf
     partition, not hand-pinned)."""
     from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        CostPin,
+        CostSpec,
         DonationSpec,
         ProgramContract,
     )
+    from distributed_tensorflow_guide_tpu.analysis.cost import closed_forms
 
     # tiny_mlp under min_shard_size=64 over 8 devices: the two (16,32)/
     # (32,16) matrices shard, the two biases replicate
     n_sharded, n_replicated, n_metrics = 2, 2, 2
+    sharded_bytes = (16 * 32 + 32 * 16) * 4    # the two sharded matrices
+    replicated_bytes = (32 + 16) * 4           # the two replicated biases
+
+    def _term(name):
+        def expect():
+            import jax
+
+            common = closed_forms()
+            terms = common.fsdp_comm_terms(
+                sharded_bytes, jax.device_count(), replicated_bytes)
+            if name == "replicated_grad_allreduce":
+                # the replicated-leaf pmeans share the psum census key
+                # with the 2 scalar metric pmeans
+                return (terms[name] + n_metrics
+                        * common.dp_allreduce_bytes(4, jax.device_count()))
+            return terms[name]
+
+        return expect
 
     def _build():
         import jax
@@ -250,5 +271,22 @@ def lint_contracts():
                 "distributed_tensorflow_guide_tpu.parallel.overlap",
                 "distributed_tensorflow_guide_tpu.collectives.collectives",
             ),
+            cost=CostSpec(
+                pins=(
+                    CostPin("collective_bytes[all_gather[data]]",
+                            _term("param_all_gather"),
+                            note="ZeRO-3 fwd: unshard both matrices, "
+                                 "S*(n-1)/n"),
+                    CostPin("collective_bytes[reduce_scatter[data]]",
+                            _term("grad_reduce_scatter"),
+                            note="ZeRO-3 bwd: reshard both matrix grads"),
+                    CostPin("collective_bytes[psum[data]]",
+                            _term("replicated_grad_allreduce"),
+                            note="bias-grad pmeans + 2 scalar metric "
+                                 "pmeans"),
+                ),
+                # sharded params never fully materialize at once, so the
+                # peak sits well under the DP step's (8,076 observed)
+                max_peak_live_bytes=10240),
             notes="manual ZeRO-3 schedule: per-leaf gather/scatter budget"),
     ]

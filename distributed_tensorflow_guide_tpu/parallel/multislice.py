@@ -360,9 +360,31 @@ def lint_contracts():
     pmean per float param leaf + per float optimizer leaf, and the metric
     pmean over (dcn, data)."""
     from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        CostPin,
+        CostSpec,
         DonationSpec,
         ProgramContract,
     )
+    from distributed_tensorflow_guide_tpu.analysis.cost import closed_forms
+
+    # tiny_mlp float state the outer sync pmeans: params + SGD momentum
+    float_state_bytes = 2 * 4288
+    sync_period, n_slices = 2, 2
+
+    def _dcn_expect():
+        return closed_forms().outer_sync_bytes(float_state_bytes, n_slices)
+
+    def _inner_expect(n_metric_pmeans):
+        def expect():
+            import jax
+
+            common = closed_forms()
+            ici_world = jax.device_count() // n_slices
+            return sync_period * common.dp_allreduce_bytes(
+                4288, ici_world) + n_metric_pmeans * \
+                common.dp_allreduce_bytes(4, ici_world)
+
+        return expect
 
     def build(outer):
         def _build():
@@ -399,6 +421,18 @@ def lint_contracts():
             },
             donation=DonationSpec(argnums=(0,)),
             sources=sources,
+            cost=CostSpec(
+                pins=(
+                    CostPin("collective_bytes[psum[dcn]]", _dcn_expect,
+                            note="outer_sync_bytes over the float state "
+                                 "(params + momentum), once per round"),
+                    CostPin("collective_bytes[psum[data]]",
+                            _inner_expect(0),
+                            note="sync_period inner grad allreduces over "
+                                 "the within-slice data axis; the metric "
+                                 "pmean rides psum[dcn,data]"),
+                ),
+                max_peak_live_bytes=49152),
             notes="two-tier round: dense ICI inner steps, one DCN sync"),
         ProgramContract(
             name="multislice_outer_off_round",
@@ -410,6 +444,18 @@ def lint_contracts():
             collectives={"psum[data]": 2},
             donation=DonationSpec(argnums=(0,)),
             sources=sources,
+            cost=CostSpec(
+                pins=(
+                    # the byte-level version of the DCN-free promise: the
+                    # quantity resolves to 0.0 when the key is absent
+                    CostPin("collective_bytes[psum[dcn]]", 0.0,
+                            note="outer=off moves ZERO bytes over DCN"),
+                    CostPin("collective_bytes[psum[data]]",
+                            _inner_expect(1),
+                            note="inner grad allreduces + the one "
+                                 "within-slice scalar metric pmean"),
+                ),
+                max_peak_live_bytes=49152),
             notes="outer=off is DCN-free by contract (bench timing "
                   "control)"),
     ]

@@ -57,7 +57,6 @@ from typing import Any, Callable, Sequence
 
 import jax
 import numpy as np
-from jax import lax
 
 import distributed_tensorflow_guide_tpu.collectives as cc
 

@@ -1588,9 +1588,23 @@ def lint_contracts():
     in the schedule) plus the stage-boundary collective census — the
     counts are pinned at the 8-device (data=4, pipe=2, M=2) fixture."""
     from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        CostPin,
+        CostSpec,
         DonationSpec,
         ProgramContract,
     )
+    from distributed_tensorflow_guide_tpu.analysis.cost import closed_forms
+
+    def _ppermute_expect():
+        # per-microbatch boundary activation: (1, 32, 16) f32 per device.
+        # The comm model counts the M useful handoffs per direction; the
+        # static schedule rotates the ring every tick including the
+        # (P-1) bubble ticks, so the trace carries (M+P-1)/M of the model
+        m, p = 2, 2
+        act_bytes = 1 * 32 * 16 * 4
+        common = closed_forms()
+        return (common.pipeline_ppermute_bytes(act_bytes, m, p)
+                * (m + p - 1) / m)
 
     def _build():
         import jax
@@ -1641,6 +1655,16 @@ def lint_contracts():
                 "distributed_tensorflow_guide_tpu.ops.fused_ce",
                 "distributed_tensorflow_guide_tpu.collectives.collectives",
             ),
+            cost=CostSpec(
+                pins=(
+                    CostPin("collective_bytes[ppermute[pipe]]",
+                            _ppermute_expect,
+                            note="stage-boundary ring traffic incl. the "
+                                 "bubble-tick rotations"),
+                ),
+                # 549,822 observed per device (params + M in-flight
+                # microbatch activation stacks + fused-CE bwd workspace)
+                max_peak_live_bytes=655360),
             notes="GPipe schedule + fused-CE head: no full logits, "
                   "bounded stage-boundary traffic"),
     ]

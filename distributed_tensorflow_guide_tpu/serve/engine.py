@@ -706,6 +706,7 @@ def lint_contracts():
     anyone reintroduces dense (slots, heads, chunk, max_len) attention
     scores into the compiled serve path."""
     from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        CostSpec,
         DonationSpec,
         ProgramContract,
     )
@@ -777,17 +778,23 @@ def lint_contracts():
         ProgramContract(
             name="serve_decode_step",
             build=_build("decode"),
+            # one 96KiB ceiling across the serve programs: the aliased
+            # pool keeps all three in the 75-91KiB band, and a dead pool
+            # donation would blow straight through it
+            cost=CostSpec(max_peak_live_bytes=98304),
             notes="fixed-slot paged decode: pool aliased in place, no "
                   "full-max_len f32 score tensor",
             **common),
         ProgramContract(
             name="serve_prefill_chunk_step",
             build=_build("prefill"),
+            cost=CostSpec(max_peak_live_bytes=98304),
             notes="B=1 chunked prefill through the same attention path",
             **common),
         ProgramContract(
             name="serve_decode_step_lora",
             build=_build("decode_lora"),
+            cost=CostSpec(max_peak_live_bytes=98304),
             notes="multi-adapter decode: gathered low-rank deltas stay "
                   "collective-free and under the f32 intermediate cap",
             **common),

@@ -368,6 +368,16 @@ def test_changed_only_selection(monkeypatch):
     picked, why = lint.select_changed([a, b], "HEAD")
     assert len(picked) == 2 and "full lint" in why
 
+    # benchmarks/common.py holds the closed-form byte/FLOP models every
+    # CostSpec pin is checked against: editing it invalidates EVERY pin,
+    # so --changed-only must widen to the full registry, not just the
+    # programs whose own sources changed
+    monkeypatch.setattr(
+        lint, "_changed_files", lambda base: ["benchmarks/common.py"])
+    picked, why = lint.select_changed([a, b], "HEAD")
+    assert len(picked) == 2
+    assert why == "benchmarks/common.py changed -> full lint"
+
 
 def test_walker_traced_text_normalizes_addresses():
     text = walker.traced_text(lambda x: x + 1.0, np.zeros((2,), np.float32))

@@ -8,7 +8,6 @@ backward matmuls).
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from distributed_tensorflow_guide_tpu.utils.flop_accounting import (
     traced_matmul_flops,

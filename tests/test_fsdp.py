@@ -9,7 +9,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 from flax.training import train_state
 from jax.sharding import PartitionSpec as P
 
@@ -100,8 +99,6 @@ def test_fsdp_matches_replicated_dp():
 
 def test_fsdp_transformer_trains():
     """FSDP on the transformer (the model family whose size motivates it)."""
-    import dataclasses
-
     from distributed_tensorflow_guide_tpu.models.transformer import (
         Transformer,
         TransformerConfig,

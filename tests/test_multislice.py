@@ -17,7 +17,7 @@ import pytest
 from flax.training import train_state
 
 import distributed_tensorflow_guide_tpu.collectives as cc
-from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
+from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec
 from distributed_tensorflow_guide_tpu.parallel.data_parallel import (
     DataParallel,
 )
@@ -33,7 +33,6 @@ from distributed_tensorflow_guide_tpu.testing.chaos import (
 )
 from distributed_tensorflow_guide_tpu.train.elastic_world import (
     ElasticSupervisor,
-    elastic_toy_worker,
     shard_bounds,
     toy_spec,
     verify_stream_accounting,

@@ -5,7 +5,6 @@ import numpy as np
 import pytest
 
 from distributed_tensorflow_guide_tpu.data.native_loader import (
-    Field,
     ImageAugment,
     NativeRecordLoader,
     PyRecordLoader,

@@ -13,7 +13,6 @@ from jax import lax
 
 from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
 from distributed_tensorflow_guide_tpu.models.transformer import (
-    Block,
     TransformerConfig,
 )
 from distributed_tensorflow_guide_tpu.parallel.pipeline import PipelinedLM

@@ -4,7 +4,6 @@ import numpy as np
 import optax
 import pytest
 
-from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec, build_mesh
 from distributed_tensorflow_guide_tpu.models.resnet import (
     ResNet,
     ResNet50,
