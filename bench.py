@@ -264,6 +264,16 @@ def run_bench() -> None:
     dstats.steps = dstats.dispatches * (
         steps_per_call if mode == "sustained" else 1)
     extras.update(dstats.as_dict())
+    # the same numbers through the unified metrics plane (obs/metrics.py):
+    # one namespace for what the ad-hoc dicts carry per-bench
+    from distributed_tensorflow_guide_tpu.obs.metrics import (
+        Registry,
+        absorb_dispatch,
+    )
+
+    obs_reg = Registry()
+    absorb_dispatch(obs_reg, dstats)
+    extras["obs_metrics"] = obs_reg.snapshot()
     trial_tput.sort()
     median = trial_tput[len(trial_tput) // 2]
     spread_pct = 100.0 * (trial_tput[-1] - trial_tput[0]) / median

@@ -204,6 +204,18 @@ def main() -> None:
         fed = args.global_batch * args.steps / (time.perf_counter() - t0)
         prefetch_stats = {**feed.stats.as_dict(),
                           **fed_step.stats.as_dict()}
+        # the same host-gap/stall numbers through the unified metrics
+        # plane (obs/metrics.py)
+        from distributed_tensorflow_guide_tpu.obs.metrics import (
+            Registry,
+            absorb_dispatch,
+            absorb_prefetch,
+        )
+
+        obs_reg = Registry()
+        absorb_prefetch(obs_reg, feed.stats)
+        absorb_dispatch(obs_reg, fed_step.stats)
+        prefetch_stats["obs_metrics"] = obs_reg.snapshot()
         loader.close()
     finally:
         os.unlink(tmp.name)

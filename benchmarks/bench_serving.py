@@ -108,6 +108,11 @@ def main() -> None:
                     help="serve the continuous side multi-LoRA: each "
                          "request decodes under adapter rid %% 4 (0 = "
                          "base) through the gathered-delta step programs")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write a Chrome/Perfetto trace-event JSON of the "
+                         "top-rate continuous run (per-slot request "
+                         "timelines, queue-wait bars, lifecycle instants) "
+                         "plus a ttft_breakdown in the JSON line")
     ap.add_argument("--small", action="store_true")
     ap.add_argument("--fake-devices", type=int, default=0)
     args = ap.parse_args()
@@ -190,11 +195,21 @@ def main() -> None:
         return out
 
     # ---- continuous side ------------------------------------------------
+    # flight recorder (PR 14): observe-only; the engine stamps events
+    # with the bench's VIRTUAL clock, so the exported timeline shows the
+    # same seconds the latency numbers are computed in
+    rec = None
+    if args.trace_out:
+        from distributed_tensorflow_guide_tpu.obs import (
+            events as obs_events,
+        )
+
+        rec = obs_events.FlightRecorder(capacity=1 << 16)
     eng = ServeEngine(serve_cfg, params, slots=args.slots,
                       num_blocks=args.num_blocks,
                       block_size=args.block_size,
                       prefill_chunk=args.prefill_chunk,
-                      temperature=0.0, adapters=bank)
+                      temperature=0.0, adapters=bank, recorder=rec)
 
     def drive(workload, e=None):
         """Virtual clock: launches charged their measured wall time,
@@ -272,6 +287,8 @@ def main() -> None:
     cont_good, ttft_p50, tpot_p50, completed = [], [], [], []
     mean_live = 0.0
     for k, rate in enumerate(rates):
+        if rec is not None:
+            rec.clear()  # the exported trace covers the top rate only
         wl = make_workload(rate, args.requests, tag=10 + k)
         ev, mean_live = drive(wl)
         lat = latencies(ev, wl)
@@ -279,6 +296,42 @@ def main() -> None:
         ttft_p50.append(float(np.median([x[0] for x in lat])))
         tpot_p50.append(float(np.median([x[1] for x in lat])))
         completed.append(len(lat))
+
+    # ---- trace export (PR 14) -------------------------------------------
+    trace_extras = {}
+    if rec is not None:
+        import json
+
+        from distributed_tensorflow_guide_tpu.obs import (
+            tracing as obs_trace,
+        )
+
+        tr = obs_trace.to_chrome_trace(rec.events())
+        out_path = Path(args.trace_out)
+        out_path.write_text(json.dumps(tr))
+        # self-validate: the written file must load back as trace-event
+        # JSON with at least one complete (X) span — a trace Perfetto
+        # would render as an empty screen fails the bench loudly
+        back = json.loads(out_path.read_text())
+        n_x = sum(1 for ev in back["traceEvents"] if ev.get("ph") == "X")
+        if n_x <= 0:
+            raise SystemExit(
+                f"--trace-out self-check failed: {args.trace_out} has "
+                "no complete (X) spans")
+        bk = obs_trace.ttft_breakdown(rec.events())
+        trace_extras = {
+            "trace_out": str(out_path),
+            "trace_events": len(back["traceEvents"]),
+            "trace_complete_spans": n_x,
+            "ttft_breakdown": {
+                "queue_wait_s_p50": round(float(np.median(
+                    [v["queue_wait_s"] for v in bk.values()])), 6),
+                "prefill_s_p50": round(float(np.median(
+                    [v["prefill_s"] for v in bk.values()])), 6),
+                "first_decode_s_p50": round(float(np.median(
+                    [v["first_decode_s"] for v in bk.values()])), 6),
+            } if bk else {},
+        }
 
     # ---- static (continuity) side at every rate -------------------------
     gens = {}
@@ -662,6 +715,7 @@ def main() -> None:
         "static_cache_bytes_per_step": decode_cache_bytes_per_step(
             cfg, args.slots),
     }
+    extras.update(trace_extras)
     extras.update(chaos_extras)
     extras.update(prefix_extras)
     report("serve_goodput", side[top], "tokens/sec",

@@ -31,6 +31,7 @@ BENCHES = [
     "bench_resilience.py",    # checkpoint overhead + MTTR/goodput (CPU-real)
     "bench_dcn_hybrid.py",    # two-tier DCN sync tradeoff + elastic resize
     "bench_serving.py",       # serving under load: continuous vs static
+    "bench_obs.py",           # flight recorder overhead + cost recon
     "bench_lint.py",          # contract linter: full program-registry audit
 ]
 
@@ -135,8 +136,18 @@ SMOKE = {
         # prefix-sharing/tenancy phase (cache ON vs OFF A/B + the
         # tenant-0 burst fairness leg) in the same smoke — no extra
         # compiles, the phases reuse the main engine's two programs
+        # --trace-out: the flight-recorder timeline of the top-rate run,
+        # self-validated (the bench exits 1 unless the written file loads
+        # back as trace-event JSON with >0 complete spans)
         ["--fake-devices", "1", "--small", "--requests", "6",
-         "--chaos", "--snapshot-restore", "--prefix-mix", "2"],
+         "--chaos", "--snapshot-restore", "--prefix-mix", "2",
+         "--trace-out", "/tmp/dtg_bench_serving_trace.json"],
+    "bench_obs.py":
+        # platform-independent like bench_resilience: recorder throughput
+        # and the disabled-overhead gate (<1% of a step) are host-CPU
+        # numbers, and the recon phase is an abstract trace (no compile)
+        ["--fake-devices", "8", "--events", "100000", "--steps", "15",
+         "--small"],
     "bench_lint.py":
         # NOT a liveness stub either: lint is trace-time only, so the
         # smoke run IS the full registry audit at the pinned 8-device

@@ -1,0 +1,42 @@
+"""Runtime observability plane: flight recorder, spans, metrics, recon.
+
+Strictly observe-only and stdlib-only at import: nothing in this package
+imports jax, and no instrumentation site ever reaches inside a compiled
+program — the recorder on/off leaves every trace byte-identical and every
+engine/train output bitwise-identical (pinned in tests/test_obs.py).
+"""
+
+from distributed_tensorflow_guide_tpu.obs.events import (
+    NULL_RECORDER,
+    FlightRecorder,
+    NullRecorder,
+    ObsEvent,
+    current,
+    install,
+    signature,
+)
+from distributed_tensorflow_guide_tpu.obs.metrics import Registry
+from distributed_tensorflow_guide_tpu.obs.recon import Roofline, reconcile
+from distributed_tensorflow_guide_tpu.obs.tracing import (
+    events_from_dump,
+    span,
+    to_chrome_trace,
+    ttft_breakdown,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "NullRecorder",
+    "NULL_RECORDER",
+    "ObsEvent",
+    "Registry",
+    "Roofline",
+    "current",
+    "events_from_dump",
+    "install",
+    "reconcile",
+    "signature",
+    "span",
+    "to_chrome_trace",
+    "ttft_breakdown",
+]

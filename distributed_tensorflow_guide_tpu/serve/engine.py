@@ -71,6 +71,7 @@ from distributed_tensorflow_guide_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
 )
+from distributed_tensorflow_guide_tpu.obs import events as obs_events
 from distributed_tensorflow_guide_tpu.serve.paged_cache import table_row
 from distributed_tensorflow_guide_tpu.serve.scheduler import (
     DECODE,
@@ -285,18 +286,26 @@ class ServeEngine:
                  snapshot_dir=None, snapshot_keep: int = 3,
                  prefix_cache: bool = False,
                  tenant_quotas=None, drr_quantum: int | None = None,
-                 adapters=None):
+                 adapters=None, recorder=None):
         self.fns = build_step_fns(
             cfg, slots=slots, num_blocks=num_blocks,
             block_size=block_size, prefill_chunk=prefill_chunk,
             temperature=temperature, top_k=top_k)
         self.params = params
         self.num_slots = slots
+        # observability (PR 14): strictly observe-only. Resolved ONCE
+        # here; every emission site guards on ``rec.enabled`` so a
+        # disabled recorder costs one attribute check per site
+        # (benchmarks/bench_obs.py pins the overhead), and nothing the
+        # recorder sees ever feeds a compiled program (the bitwise
+        # recorder-on/off parity tests pin that).
+        self.rec = recorder if recorder is not None else obs_events.current()
         self.sched = Scheduler(
             slots=slots, num_blocks=num_blocks, block_size=block_size,
             prefill_chunk=prefill_chunk, max_len=self.fns.cfg.max_len,
             max_queue=max_queue, prefix_cache=prefix_cache,
-            tenant_quotas=tenant_quotas, drr_quantum=drr_quantum)
+            tenant_quotas=tenant_quotas, drr_quantum=drr_quantum,
+            recorder=self.rec)
         if self.fns.lora:
             # the bank is a jit-operand (not a closed-over constant):
             # swapping adapter weights never retraces the two programs
@@ -324,7 +333,8 @@ class ServeEngine:
         self._ttft_ewma: float | None = None  # predicted-TTFT shed gate
         self.last_tick_s = 0.0
         self._step_deadline_s = step_deadline_s
-        self._watchdog = (Watchdog(name="serve-engine")
+        self._watchdog = (Watchdog(name="serve-engine",
+                                   recorder=self.rec)
                           if step_deadline_s else None)
         self.snapshot_dir = snapshot_dir
         self._ckpt = None
@@ -361,12 +371,27 @@ class ServeEngine:
                 and self._ttft_ewma is not None
                 and self._ttft_ewma > req.ttft_deadline_s):
             self.sched.shed += 1
+            if self.rec.enabled:
+                self.rec.emit(
+                    "req.shed", cat="serve", actor="engine",
+                    payload={"rid": req.rid, "reason": "ttft",
+                             "tenant": int(req.tenant),
+                             "ttft_s": self._ttft_ewma},
+                    t=float(req.arrival))
             raise EngineOverloaded(
                 f"request {req.rid} shed: recent TTFT "
                 f"{self._ttft_ewma:.3f}s exceeds its "
                 f"{req.ttft_deadline_s:.3f}s deadline — retry later")
         self.sched.submit(dataclasses.replace(
             req, prompt=prompt, rng=np.asarray(req.rng, np.uint32)))
+        if self.rec.enabled:
+            self.rec.emit(
+                "req.submit", cat="serve", actor="engine",
+                payload={"rid": req.rid, "tenant": int(req.tenant),
+                         "adapter": int(req.adapter),
+                         "prompt_len": int(prompt.size),
+                         "max_new": int(req.max_new_tokens)},
+                t=float(req.arrival))
 
     def cancel(self, rid: int) -> bool:
         """Client abandon: free the stream's slot+blocks at the next step
@@ -383,12 +408,29 @@ class ServeEngine:
         this call to get per-launch service time."""
         tick = self._tick
         self._tick += 1
+        rec = self.rec
+        if rec.enabled:
+            self.sched.now = now  # timestamps scheduler decisions
         if self.chaos is not None:
+            if rec.enabled:
+                self.chaos.recorder = rec
+                self.chaos.obs_now = now
             self._apply_chaos(tick, now)
         self._release_pressure(tick)
         events = [Event(now, *t) for t in self.sched.sweep(now)]
         self.sched.admit(now)
         kind, arg = self.sched.plan()
+        launch = None
+        if rec.enabled and kind != "idle":
+            # capture launch identity BEFORE the program runs: apply_*
+            # frees a slot the moment its request completes
+            if kind == PREFILL:
+                s = self.sched.slots[arg]
+                launch = {"slot": arg, "rid": s.rid,
+                          "chunk": s.chunk_cursor}
+            else:
+                launch = {"slots": list(arg),
+                          "rids": [self.sched.slots[i].rid for i in arg]}
         t0 = time.perf_counter()
         if kind == PREFILL:
             events.extend(self._run_prefill(arg, now))
@@ -396,6 +438,11 @@ class ServeEngine:
             events.extend(self._run_decode(arg, now))
         self.last_tick_s = time.perf_counter() - t0
         self.steps[kind] += 1
+        if launch is not None:
+            launch["tick"] = tick
+            launch["dur_s"] = self.last_tick_s
+            rec.emit(f"{kind}.launch", cat="serve", actor="engine",
+                     payload=launch, t=now)
         for e in events:
             if e.first and e.status == "ok":
                 arrival = self.sched.meta.get(e.rid, (now, None, None))[0]
@@ -404,7 +451,35 @@ class ServeEngine:
                     self._ttft_ewma = (
                         ttft if self._ttft_ewma is None
                         else 0.8 * self._ttft_ewma + 0.2 * ttft)
+        if rec.enabled and events:
+            self._emit_lifecycle(events, now, tick)
         return events, kind
+
+    def _emit_lifecycle(self, events: list[Event], now: float,
+                        tick: int) -> None:
+        """Map the tick's swept/produced events onto recorder instants:
+        ``req.first_token`` / ``req.done`` for streams, ``req.cancelled``
+        / ``req.expired`` for sweep casualties."""
+        rec = self.rec
+        for e in events:
+            if e.status != "ok":
+                rec.emit(f"req.{e.status}", cat="serve", actor="engine",
+                         payload={"rid": e.rid, "tick": tick}, t=now)
+                continue
+            if e.first:
+                payload = {"rid": e.rid, "tick": tick}
+                arrival = self.sched.meta.get(e.rid, (now, None, None))[0]
+                ttft = now - arrival
+                if np.isfinite(ttft):
+                    payload["ttft_s"] = float(max(0.0, ttft))
+                rec.emit("req.first_token", cat="serve", actor="engine",
+                         payload=payload, t=now)
+            if e.done:
+                rec.emit("req.done", cat="serve", actor="engine",
+                         payload={"rid": e.rid, "tick": tick,
+                                  "tokens": len(self.sched.emitted.get(
+                                      e.rid, []))},
+                         t=now)
 
     def _launch(self, fn, tag: str):
         """One guarded program launch: a per-attempt watchdog deadline
@@ -650,6 +725,12 @@ class ServeEngine:
                                async_=async_):
             return None
         self._last_snap = label
+        if self.rec.enabled:
+            self.rec.emit(
+                "snapshot.save", cat="serve", actor="engine",
+                payload={"label": int(label),
+                         "requests": len(state["sched"]["requests"]),
+                         "async": bool(async_)})
         return label
 
     def restore_latest_snapshot(self) -> int | None:
@@ -665,6 +746,9 @@ class ServeEngine:
             raise ValueError("ServeEngine(snapshot_dir=...) not configured")
         got = self._ckpt.restore_latest_valid(None)
         if got is None:
+            if self.rec.enabled:
+                self.rec.emit("snapshot.restore_miss", cat="serve",
+                              actor="engine", payload={})
             return None
         tree, label = got
         state = json.loads(
@@ -674,6 +758,11 @@ class ServeEngine:
         for k, v in state["steps"].items():
             self.steps[k] = int(v)
         self._last_snap = label
+        if self.rec.enabled:
+            self.rec.emit(
+                "snapshot.restore", cat="serve", actor="engine",
+                payload={"label": int(label),
+                         "requests": len(state["sched"]["requests"])})
         return label
 
     def close(self) -> None:

@@ -191,6 +191,18 @@ class BlockPool:
         if released:
             self._free.sort(reverse=True)
 
+    def stats(self) -> dict:
+        """Occupancy snapshot for the metrics plane
+        (``obs.metrics.absorb_pool``) — pure reads, no state change."""
+        shared = sum(1 for h in self._holders.values() if len(h) > 1)
+        return {
+            "capacity": self.capacity,
+            "free": len(self._free),
+            "live": len(self._holders),
+            "shared": shared,
+            "holds": sum(len(h) for h in self._holders.values()),
+        }
+
     def check_leaks(self) -> None:
         """Every block accounted for exactly once (the accounting test):
         free + distinct-live == capacity, nothing both free and live,

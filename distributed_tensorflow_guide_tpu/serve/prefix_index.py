@@ -135,6 +135,28 @@ class PrefixIndex:
             children = node.children
         return created
 
+    def stats(self) -> dict:
+        """Trie-shape snapshot for the metrics plane
+        (``obs.metrics.absorb_prefix``) — pure reads, no LRU touches."""
+        leaves = 0
+        depth = 0
+        for children in self._roots.values():
+            stack = [(n, 1) for n in children.values()]
+            while stack:
+                node, d = stack.pop()
+                depth = max(depth, d)
+                if node.children:
+                    stack.extend(
+                        (c, d + 1) for c in node.children.values())
+                else:
+                    leaves += 1
+        return {
+            "nodes": self._count,
+            "leaves": leaves,
+            "max_depth": depth,
+            "adapters": len(self._roots),
+        }
+
     def _evictable(self, adapter: int, node: _Node,
                    pool: BlockPool) -> bool:
         return not node.children and pool.refcount(node.block) == 1

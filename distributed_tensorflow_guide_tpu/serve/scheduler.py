@@ -55,6 +55,7 @@ import math
 
 import numpy as np
 
+from distributed_tensorflow_guide_tpu.obs import events as obs_events
 from distributed_tensorflow_guide_tpu.serve.paged_cache import (
     BlockPool,
     blocks_for,
@@ -127,7 +128,8 @@ class Scheduler:
                  max_queue: int | None = None,
                  prefix_cache: bool = False,
                  tenant_quotas: dict[int, dict] | None = None,
-                 drr_quantum: int | None = None) -> None:
+                 drr_quantum: int | None = None,
+                 recorder=None) -> None:
         if max_len % prefill_chunk:
             raise ValueError(
                 f"prefill_chunk {prefill_chunk} must divide max_len "
@@ -180,6 +182,12 @@ class Scheduler:
         self.prefix_hit_tokens = 0
         self.prefill_tokens_saved = 0
         self.prefix_evictions = 0
+        # observability (PR 14): observe-only. The engine passes its
+        # recorder so both sides share one event stream, and refreshes
+        # ``now`` (the semantic clock) at the top of every tick.
+        self.rec = (recorder if recorder is not None
+                    else obs_events.current())
+        self.now = 0.0
 
     def _tc(self, tenant: int) -> dict[str, int]:
         return self.tenants.setdefault(int(tenant), {
@@ -218,6 +226,13 @@ class Scheduler:
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
             self.shed += 1
             self._tc(req.tenant)["shed"] += 1
+            if self.rec.enabled:
+                self.rec.emit(
+                    "req.shed", cat="serve", actor="scheduler",
+                    payload={"rid": req.rid, "reason": "queue_depth",
+                             "tenant": int(req.tenant),
+                             "queue_depth": len(self.queue)},
+                    t=float(req.arrival))
             raise EngineOverloaded(
                 f"request {req.rid} shed: queue depth {len(self.queue)} at "
                 f"the max_queue={self.max_queue} gate — retry later "
@@ -294,6 +309,23 @@ class Scheduler:
                 if prefix_len:
                     self.prefix_hit_tokens += prefix_len
                     self.prefill_tokens_saved += prefix_len
+                if self.rec.enabled:
+                    payload = {"rid": req.rid, "slot": s,
+                               "tenant": tenant,
+                               "prefix_len": prefix_len,
+                               "blocks": len(blocks)}
+                    w = self.now - float(req.arrival)
+                    if math.isfinite(w):
+                        payload["queue_wait_s"] = max(0.0, w)
+                    self.rec.emit("req.admit", cat="serve",
+                                  actor="scheduler", payload=payload,
+                                  t=self.now)
+                    if prefix_len:
+                        self.rec.emit("prefix.hit", cat="serve",
+                                      actor="scheduler",
+                                      payload={"rid": req.rid,
+                                               "tokens": prefix_len},
+                                      t=self.now)
                 admitted.append(s)
                 progressed = True
             if not progressed and not deficit_waiting:
@@ -360,6 +392,10 @@ class Scheduler:
         while (fresh is None and self.prefix is not None
                and self.prefix.evict_one(self.pool) is not None):
             self.prefix_evictions += 1
+            if self.rec.enabled:
+                self.rec.emit("prefix.evict", cat="serve",
+                              actor="scheduler",
+                              payload={"reason": "admit"}, t=self.now)
             fresh = self.pool.alloc(req.rid, need - len(shared))
         if fresh is None:
             if shared:
@@ -417,6 +453,11 @@ class Scheduler:
                 if (self.prefix is not None
                         and self.prefix.evict_one(self.pool) is not None):
                     self.prefix_evictions += 1
+                    if self.rec.enabled:
+                        self.rec.emit("prefix.evict", cat="serve",
+                                      actor="scheduler",
+                                      payload={"reason": "decode_grow"},
+                                      t=self.now)
                     continue
                 victim = self._pick_victim(exclude=i)
                 if victim is None:
@@ -463,6 +504,11 @@ class Scheduler:
         self.slots[i] = None
         self.preemptions += 1
         self._tc(slot.tenant)["preempted"] += 1
+        if self.rec.enabled:
+            self.rec.emit("req.preempt", cat="serve", actor="scheduler",
+                          payload={"rid": slot.rid, "slot": i,
+                                   "emitted": slot.emitted_here,
+                                   "tenant": slot.tenant}, t=self.now)
 
     # ---- result application ---------------------------------------------
 

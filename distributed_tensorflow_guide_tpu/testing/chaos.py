@@ -83,6 +83,8 @@ from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import numpy as np
 
+from distributed_tensorflow_guide_tpu.obs import events as obs_events
+
 log = logging.getLogger("dtg.chaos")
 
 DATA_KINDS = ("nan_batch", "iterator_stall", "ckpt_truncate", "ckpt_corrupt")
@@ -236,6 +238,13 @@ class FaultSchedule:
         self.fired: list[Fault] = []
         self._pending = set(self.faults)
         self._step_calls = 0
+        # observability (PR 14): every firing lands in the flight
+        # recorder as a ``chaos.fault`` instant. The serve engine stamps
+        # ``recorder``/``obs_now`` with its own recorder and semantic
+        # clock each tick; standalone schedules use the process-global
+        # recorder (disabled by default) with no semantic timestamp.
+        self.recorder = obs_events.current()
+        self.obs_now: float | None = None
 
     @classmethod
     def random(cls, seed: int, *, max_position: int,
@@ -370,6 +379,7 @@ class FaultSchedule:
                              "fired, or never scheduled)")
         self._pending.discard(fault)
         self.fired.append(fault)
+        self._record(fault)
 
     def _take(self, position: int, kinds: Sequence[str]) -> list[Fault]:
         due = [f for f in self._pending
@@ -377,7 +387,16 @@ class FaultSchedule:
         for f in due:
             self._pending.discard(f)
             self.fired.append(f)
+            self._record(f)
         return due
+
+    def _record(self, fault: Fault) -> None:
+        if self.recorder.enabled:
+            self.recorder.emit(
+                "chaos.fault", cat="chaos", actor="schedule",
+                payload={"kind": fault.kind, "position": fault.position,
+                         "param": fault.param, "tenant": fault.tenant},
+                t=self.obs_now)
 
     # ---- injectors ---------------------------------------------------------
 

@@ -38,6 +38,7 @@ import math
 from collections import deque
 from typing import Mapping
 
+from distributed_tensorflow_guide_tpu.obs import events as obs_events
 from distributed_tensorflow_guide_tpu.train.hooks import BaseHook
 
 log = logging.getLogger("dtg.train")
@@ -85,7 +86,7 @@ class AnomalySentinelHook(BaseHook):
                  grad_norm_key: str = "grad_norm",
                  spike_factor: float = 10.0, window: int = 20,
                  budget: int = 3, check_every: int = 1,
-                 skip_offending: bool = False):
+                 skip_offending: bool = False, recorder=None):
         if budget < 1:
             raise ValueError(f"budget must be >= 1, got {budget}")
         if check_every < 1:
@@ -107,6 +108,9 @@ class AnomalySentinelHook(BaseHook):
         # the "a tripped state is never checkpointed" guarantee
         # cadence-independent.
         self.save_cadence: int | None = None
+        # observability (PR 14): trips land in the flight recorder; a
+        # blown budget crash-dumps the tail (the black-box protocol)
+        self.rec = recorder if recorder is not None else obs_events.current()
 
     def begin(self, loop) -> None:
         # a rolled-back run replays from an older state: the pre-anomaly
@@ -150,7 +154,18 @@ class AnomalySentinelHook(BaseHook):
         self.trips.append((step, reason))
         log.warning("anomaly sentinel tripped (%d/%d): %s",
                     len(self.trips), self.budget, reason)
+        if self.rec.enabled:
+            self.rec.emit("anomaly.trip", cat="train", actor="sentinel",
+                          payload={"step": step, "reason": reason,
+                                   "trips": len(self.trips),
+                                   "budget": self.budget})
         if len(self.trips) > self.budget:
+            if self.rec.enabled:
+                self.rec.crash_dump(
+                    "anomaly.budget_exceeded", cat="train",
+                    actor="sentinel",
+                    payload={"step": step, "trips": len(self.trips),
+                             "budget": self.budget})
             raise AnomalyBudgetExceeded(
                 f"{len(self.trips)} anomalies exceed the budget of "
                 f"{self.budget}: {self.trips}"

@@ -47,6 +47,7 @@ from typing import Any
 import jax
 import orbax.checkpoint as ocp
 
+from distributed_tensorflow_guide_tpu.obs import events as obs_events
 from distributed_tensorflow_guide_tpu.train.hooks import BaseHook
 
 log = logging.getLogger("dtg.train")
@@ -84,7 +85,7 @@ class Checkpointer:
     """Thin wrapper over ocp.CheckpointManager for train states."""
 
     def __init__(self, directory: str | Path, max_to_keep: int = 3,
-                 default_layout: dict | None = None):
+                 default_layout: dict | None = None, recorder=None):
         """``default_layout``: layout-identity dict applied to every
         save/restore that doesn't pass ``layout=`` explicitly. This is how
         hook-driven checkpoints (CheckpointHook, PreemptionHook) and
@@ -94,6 +95,9 @@ class Checkpointer:
         self.directory = Path(directory).absolute()
         self.default_layout = default_layout
         self._pending_step: int | None = None
+        # observability (PR 14): save/restore-ladder outcomes land in the
+        # flight recorder — observe-only, never part of the commit protocol
+        self.rec = recorder if recorder is not None else obs_events.current()
         self.cleaned_on_start = self._clean_stale_tmp()
         self._mngr = ocp.CheckpointManager(
             self.directory,
@@ -169,6 +173,10 @@ class Checkpointer:
             self._write_manifest(step)
             self._gc_sidecars()
             log.info("saved checkpoint at step %d -> %s", step, self.directory)
+        if self.rec.enabled:
+            self.rec.emit("ckpt.save", cat="train", actor="checkpointer",
+                          payload={"step": int(step), "async": bool(async_),
+                                   "force": bool(force)})
         return saved
 
     def _commit_pending(self) -> None:
@@ -336,6 +344,11 @@ class Checkpointer:
                     "restored step %d from %s",
                     skipped, step, self.directory,
                 )
+            if self.rec.enabled:
+                self.rec.emit("ckpt.restore", cat="train",
+                              actor="checkpointer",
+                              payload={"step": int(step),
+                                       "skipped": [int(s) for s in skipped]})
             return state, step
         if skipped:
             log.error(
@@ -343,6 +356,10 @@ class Checkpointer:
                 "corrupt/invalid — degrading to a fresh start",
                 skipped, self.directory,
             )
+        if self.rec.enabled:
+            self.rec.emit("ckpt.restore_miss", cat="train",
+                          actor="checkpointer",
+                          payload={"skipped": [int(s) for s in skipped]})
         return None
 
     def wait(self) -> None:

@@ -26,6 +26,7 @@ import logging
 import signal as _signal
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
+from distributed_tensorflow_guide_tpu.obs import events as obs_events
 from distributed_tensorflow_guide_tpu.train.anomaly import (
     AnomalyDetected,
     AnomalySentinelHook,
@@ -107,6 +108,9 @@ def run_with_recovery(
     instead of a silent stall.
     """
     restarts = 0
+    # observability (PR 14): supervision transitions (restore outcome per
+    # attempt, every restart decision) land in the flight recorder
+    rec = obs_events.current()
     sentinels = [h for h in hooks if isinstance(h, AnomalySentinelHook)]
     others = [h for h in hooks if not isinstance(h, AnomalySentinelHook)]
     for s in sentinels:
@@ -120,6 +124,10 @@ def run_with_recovery(
             state, start = init_state, 0
         else:
             state, start = restored
+        if rec.enabled:
+            rec.emit("elastic.restore", cat="train", actor="supervisor",
+                     payload={"start": start, "restarts": restarts,
+                              "fresh": restored is None})
         data = (
             _skipping_stream(make_data, start, skips)
             if skips else make_data(start)
@@ -140,7 +148,16 @@ def run_with_recovery(
             return loop.run()
         except recoverable as e:
             restarts += 1
+            if rec.enabled:
+                rec.emit("elastic.restart", cat="train", actor="supervisor",
+                         payload={"step": loop.step, "restarts": restarts,
+                                  "error": type(e).__name__})
             if restarts > max_restarts:
+                if rec.enabled:
+                    rec.crash_dump(
+                        "elastic.give_up", cat="train", actor="supervisor",
+                        payload={"step": loop.step, "restarts": restarts,
+                                 "error": type(e).__name__})
                 raise TooManyRestarts(
                     f"gave up after {max_restarts} restarts: {e}"
                 ) from e
@@ -244,6 +261,10 @@ class PreemptionHook:
         self.ckpt.save(done, self._loop.state, force=True)
         self.ckpt.wait()
         self.preempted_at = done
+        rec = obs_events.current()
+        if rec.enabled:
+            rec.emit("elastic.preempt", cat="train", actor="preemption",
+                     payload={"step": int(done)})
         log.warning("preemption signal: saved step %d, stopping", done)
 
     def after_step(self, step: int, metrics) -> None:

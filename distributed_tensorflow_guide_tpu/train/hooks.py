@@ -23,6 +23,10 @@ from pathlib import Path
 from typing import Any, Mapping, Protocol
 
 from distributed_tensorflow_guide_tpu.core.dist import is_chief
+from distributed_tensorflow_guide_tpu.obs.metrics import (
+    Registry,
+    absorb_dispatch,
+)
 
 log = logging.getLogger("dtg.train")
 
@@ -143,6 +147,51 @@ class TensorBoardHook(BaseHook):
         if self._writer:
             self._writer.close()
             self._writer = None
+
+
+class MetricsHook(BaseHook):
+    """Opt-in bridge from the loop to the obs metrics plane: every step
+    bumps ``dtg_train_steps_total`` and mirrors scalar metrics into
+    gauges; every ``every_steps`` the loop's dispatch stats are absorbed
+    and (optionally) the whole registry snapshot goes to a
+    ``utils/tb_writer.SummaryWriter`` via ``log_metrics``. Reading a
+    metric value syncs it to host — same cost as LoggingHook, and the
+    reason this hook is opt-in rather than default."""
+
+    def __init__(self, registry: Registry | None = None, *,
+                 every_steps: int = 10, writer=None):
+        self.registry = registry if registry is not None else Registry()
+        self.every_steps = every_steps
+        self.writer = writer
+        self._loop = None
+
+    def begin(self, loop) -> None:
+        self._loop = loop
+
+    def after_step(self, step: int, metrics) -> None:
+        reg = self.registry
+        reg.counter("dtg_train_steps_total",
+                    "optimizer steps observed by MetricsHook").inc()
+        for k, v in metrics.items():
+            try:
+                fv = float(v)
+            except (TypeError, ValueError):
+                continue
+            reg.gauge(f"dtg_train_metric_{k}").set(fv)
+        if step % self.every_steps:
+            return
+        stats = getattr(self._loop, "dispatch_stats", None)
+        if stats is not None:
+            absorb_dispatch(reg, stats)
+        if self.writer is not None:
+            self.writer.log_metrics(reg.snapshot(), step)
+
+    def end(self, step: int) -> None:
+        stats = getattr(self._loop, "dispatch_stats", None)
+        if stats is not None:
+            absorb_dispatch(self.registry, stats)
+        if self.writer is not None:
+            self.writer.log_metrics(self.registry.snapshot(), step)
 
 
 class MetricsJSONLHook(BaseHook):

@@ -32,6 +32,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
+from distributed_tensorflow_guide_tpu.obs import events as obs_events
 from distributed_tensorflow_guide_tpu.train.hooks import Hook
 
 log = logging.getLogger("dtg.train")
@@ -71,6 +72,7 @@ class TrainLoop:
         data_deadline_s: float | None = None,
         watchdog_action: Any = "interrupt",
         watchdog_diag_path: Any = None,
+        recorder: Any = None,
     ):
         if steps_per_call < 1:
             raise ValueError(
@@ -97,6 +99,12 @@ class TrainLoop:
         self._stop = False
         self.stop_reason: str | None = None
         self._last_return: float | None = None
+        # observability (PR 14): observe-only — span.begin/span.end
+        # instants around data-wait and dispatch (the trace exporter's
+        # per-step train timeline). Resolved once; every emission is
+        # behind one ``enabled`` attribute check, and nothing recorded
+        # ever feeds the compiled step (50-step bitwise parity pinned).
+        self.rec = recorder if recorder is not None else obs_events.current()
         from distributed_tensorflow_guide_tpu.utils.profiling import (
             DispatchStats,
         )
@@ -207,7 +215,8 @@ class TrainLoop:
             )
 
             wd = Watchdog(name="train-loop", action=self.watchdog_action,
-                          diag_path=self.watchdog_diag_path)
+                          diag_path=self.watchdog_diag_path,
+                          recorder=self.rec)
         try:
             try:
                 # begin() inside the try: if a later hook's begin raises,
@@ -216,24 +225,45 @@ class TrainLoop:
                 for h in self.hooks:
                     h.begin(self)
                 it: Iterator = iter(self.data)
+                rec = self.rec
                 while not self._stop:
                     if wd and self.data_deadline_s:
                         wd.arm("data iterator", self.data_deadline_s)
+                    if rec.enabled:
+                        rec.emit("span.begin", cat="train", actor="loop",
+                                 payload={"name": "data_wait",
+                                          "track": "loop",
+                                          "step": self.step})
                     try:
                         batch = next(it)
                     except StopIteration:
                         break
                     finally:
+                        if rec.enabled:
+                            rec.emit("span.end", cat="train", actor="loop",
+                                     payload={"name": "data_wait",
+                                              "track": "loop"})
                         if wd:
                             wd.disarm()
                             wd.check()
                     if wd and self.step_deadline_s:
                         wd.arm("train step", self.step_deadline_s)
-                    if self.steps_per_call > 1:
-                        self._run_packed(batch)
-                    else:
-                        self._after_step(
-                            self._dispatch(self.step_fn, batch))
+                    if rec.enabled:
+                        rec.emit("span.begin", cat="train", actor="loop",
+                                 payload={"name": "dispatch",
+                                          "track": "loop",
+                                          "step": self.step})
+                    try:
+                        if self.steps_per_call > 1:
+                            self._run_packed(batch)
+                        else:
+                            self._after_step(
+                                self._dispatch(self.step_fn, batch))
+                    finally:
+                        if rec.enabled:
+                            rec.emit("span.end", cat="train", actor="loop",
+                                     payload={"name": "dispatch",
+                                              "track": "loop"})
                     if wd:
                         wd.disarm()
                         wd.check()

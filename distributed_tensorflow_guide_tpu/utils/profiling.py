@@ -28,6 +28,7 @@ from typing import Any, Callable, Iterator
 
 import jax
 
+from distributed_tensorflow_guide_tpu.obs import events as obs_events
 from distributed_tensorflow_guide_tpu.train.hooks import BaseHook
 
 log = logging.getLogger("dtg.profiling")
@@ -128,7 +129,8 @@ class ProfilerHook(BaseHook):
     restrict."""
 
     def __init__(self, logdir: str | Path, start_step: int = 10,
-                 end_step: int = 15, chief_only: bool = False):
+                 end_step: int = 15, chief_only: bool = False,
+                 recorder=None):
         if end_step <= start_step:
             raise ValueError("end_step must be > start_step")
         self.logdir = str(logdir)
@@ -136,6 +138,14 @@ class ProfilerHook(BaseHook):
         self.end_step = end_step
         self.chief_only = chief_only
         self._active = False
+        # observability (PR 14): profiler.start/profiler.stop instants
+        # in the flight recorder bracket the XPlane trace window
+        self.rec = recorder if recorder is not None else obs_events.current()
+
+    def _obs(self, kind: str, step: int | None) -> None:
+        if self.rec.enabled:
+            self.rec.emit(kind, cat="train", actor="profiler",
+                          payload={"logdir": self.logdir, "step": step})
 
     def _enabled(self) -> bool:
         if not self.chief_only:
@@ -152,10 +162,12 @@ class ProfilerHook(BaseHook):
             # never ran end(); JAX allows one active trace, so close it out
             jax.profiler.stop_trace()
             self._active = False
+            self._obs("profiler.stop", None)
         first = getattr(loop, "step", 0)
         if self._enabled() and self.start_step <= first < self.end_step:
             jax.profiler.start_trace(self.logdir)
             self._active = True
+            self._obs("profiler.start", first)
 
     def after_step(self, step: int, metrics) -> None:
         # after_step(step) runs once step `step` is done; start the trace
@@ -165,9 +177,11 @@ class ProfilerHook(BaseHook):
         if (not self._active and self.start_step <= step + 1 < self.end_step):
             jax.profiler.start_trace(self.logdir)
             self._active = True
+            self._obs("profiler.start", step + 1)
         elif self._active and step + 1 >= self.end_step:
             jax.profiler.stop_trace()
             self._active = False
+            self._obs("profiler.stop", step + 1)
             log.info("profiler trace for steps [%d, %d) written to %s",
                      self.start_step, self.end_step, self.logdir)
 
@@ -175,3 +189,4 @@ class ProfilerHook(BaseHook):
         if self._active:  # loop stopped mid-window
             jax.profiler.stop_trace()
             self._active = False
+            self._obs("profiler.stop", step)

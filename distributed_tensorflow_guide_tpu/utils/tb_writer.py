@@ -118,6 +118,22 @@ class SummaryWriter:
         # TensorBoard; event volume is low (scalars only)
         self._fh.flush()
 
+    def log_metrics(self, snapshot: Mapping[str, object],
+                    step: int) -> None:
+        """Write an ``obs.metrics Registry.snapshot()`` as one scalar
+        event: plain counters/gauges keep their (labeled) name, histogram
+        dicts expand to ``<name>_count`` / ``<name>_sum`` (bucket detail
+        stays in the Prometheus exposition — TB scalars can't render it)."""
+        scalars: dict[str, float] = {}
+        for name, v in snapshot.items():
+            if isinstance(v, Mapping):
+                scalars[f"{name}_count"] = float(v.get("count", 0))
+                scalars[f"{name}_sum"] = float(v.get("sum", 0.0))
+            else:
+                scalars[name] = float(v)
+        if scalars:
+            self.scalars(step, scalars)
+
     def flush(self) -> None:
         self._fh.flush()
 

@@ -34,6 +34,8 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Callable
 
+from distributed_tensorflow_guide_tpu.obs import events as obs_events
+
 log = logging.getLogger("dtg.watchdog")
 
 KILL_EXIT_CODE = 124  # same convention as coreutils `timeout`
@@ -71,10 +73,14 @@ class Watchdog:
     def __init__(self, *, name: str = "watchdog",
                  diag_path: str | Path | None = None,
                  action: str | Callable[[TripInfo], None] = "interrupt",
-                 poll_s: float = 0.02):
+                 poll_s: float = 0.02, recorder=None):
         if isinstance(action, str) and action not in ("interrupt", "kill"):
             raise ValueError(f"unknown watchdog action {action!r}")
         self.name = name
+        # observability (PR 14): a trip is the canonical black-box
+        # moment — _dump crash-dumps the flight-recorder tail alongside
+        # the thread stacks (observe-only; the trip itself is unchanged)
+        self.rec = recorder if recorder is not None else obs_events.current()
         self.diag_path = Path(diag_path) if diag_path else None
         self.action = action
         self.poll_s = poll_s
@@ -150,6 +156,19 @@ class Watchdog:
             self._act(info)
 
     def _dump(self, info: TripInfo) -> None:
+        rec = self.rec
+        if rec.enabled:
+            try:
+                rec.crash_dump(
+                    "watchdog.trip", cat="watchdog", actor=self.name,
+                    payload={"tag": info.tag,
+                             "deadline_s": info.deadline_s,
+                             "waited_s": info.waited_s},
+                    path=rec.crash_dump_path or (
+                        f"{self.diag_path}.flightrec.json"
+                        if self.diag_path else None))
+            except Exception:
+                log.exception("%s: flight-recorder dump failed", self.name)
         try:
             if self.diag_path is not None:
                 self.diag_path.parent.mkdir(parents=True, exist_ok=True)
