@@ -64,6 +64,13 @@ def main() -> None:
     ap.add_argument("--fsdp-prefetch", choices=["auto", "on", "off"],
                     default="off",
                     help="fsdp mode: which side is the headline value")
+    ap.add_argument("--compress", choices=["off", "int8"], default="off",
+                    help="dp mode: gradient wire representation for the "
+                         "overlap ('on') side — 'int8' quantizes each "
+                         "bucket to int8 around the psum with a shared "
+                         "per-bucket f32 scale (quarter the grad bytes + "
+                         "a 4-byte pmax side-channel per bucket); "
+                         "numerics-changing, so never auto")
     ap.add_argument("--bucket-mb", type=float, default=None,
                     help="dp mode: explicit gradient-bucket budget in MiB "
                          "(default: autotune table, else the tested "
@@ -206,6 +213,10 @@ def main() -> None:
     results["floor"] = timed(floor_step, dp_repl.replicate(fresh_state()),
                              repl_batch)
 
+    compress = ov.resolve_compress(args.compress)
+    if compress and args.mode != "dp":
+        sys.exit("--compress int8 rides the bucketed DP backward "
+                 "(--mode dp)")
     if args.mode == "dp":
         headline = "on" if ov.resolve_overlap(args.overlap) else "off"
         if args.tune and on_tpu:
@@ -222,17 +233,34 @@ def main() -> None:
                 param_bytes=grad_bytes, world=dp_t.world,
                 dtype=jnp.float32, measure=measure)
         dp_off = DataParallel(mesh)
-        dp_on = DataParallel(mesh, overlap=True, bucket_bytes=bucket_bytes)
+        dp_on = DataParallel(mesh, overlap=True, bucket_bytes=bucket_bytes,
+                             compress=args.compress)
         batch = repl_batch
 
         results["off"] = timed(dp_off.make_train_step(loss_fn, donate=False),
                                dp_off.replicate(fresh_state()), batch)
-        results["on"] = timed(dp_on.make_train_step(loss_fn, donate=False),
-                              dp_on.replicate(fresh_state()), batch)
+        step_on = dp_on.make_train_step(loss_fn, donate=False)
+        results["on"] = timed(step_on, dp_on.replicate(fresh_state()), batch)
         comm_bytes = dp_allreduce_bytes(grad_bytes, n_dev)
+        # modeled vs measured wire bytes for the ON side: the closed-form
+        # ring model against what an abstract re-trace of the on-step
+        # actually records at the collective wrappers (payloads ring-
+        # adjusted the same way). Uncompressed they agree up to the two
+        # scalar metric pmeans; int8 drops the grad term ~4x and adds the
+        # per-bucket 4-byte scale pmax side-channel.
+        with cc.trace_comm() as rec:
+            jax.eval_shape(step_on, jax.eval_shape(fresh_state), batch)
+        frac = (n_dev - 1) / n_dev
+        traced = sum(2.0 * b * frac for b in rec.bytes.values())
+        extras["grad_comm_bytes_modeled_on"] = round(
+            dp_allreduce_bytes(grad_bytes, n_dev, compress=compress), 1)
+        extras["comm_bytes_traced_on"] = round(traced, 1)
+        extras["traced_payload_bytes_on"] = {
+            key: int(v) for key, v in sorted(rec.bytes.items())}
         extras["bucket_bytes"] = dp_on.bucket_bytes or (
-            autotune.bucket_bytes_for(param_bytes=grad_bytes,
-                                      world=n_dev, dtype=jnp.float32))
+            autotune.bucket_bytes_for(
+                param_bytes=grad_bytes, world=n_dev,
+                dtype=np.int8 if compress else jnp.float32))
         extras["tuned"] = bool(args.tune and on_tpu)
     else:
         headline = "on" if ov.resolve_prefetch(args.fsdp_prefetch) else "off"
@@ -281,6 +309,7 @@ def main() -> None:
     n_tokens = B * S
     extras.update({
         "overlap": headline,
+        "compress": args.compress,
         "secs_floor": round(results["floor"], 6),
         "secs_off": round(results["off"], 6),
         "secs_on": round(results["on"], 6),

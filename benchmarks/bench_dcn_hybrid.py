@@ -58,6 +58,13 @@ def main() -> None:
     ap.add_argument("--global-batch", type=int, default=8)
     ap.add_argument("--outer-lr", type=float, default=0.7)
     ap.add_argument("--outer-momentum", type=float, default=0.9)
+    ap.add_argument("--compress", choices=["off", "int8"], default="off",
+                    help="outer-sync wire representation: 'int8' "
+                         "quantizes the outer delta (and momentum sync) "
+                         "to int8 around the DCN psum with a shared f32 "
+                         "scale — quarter the outer_sync_bytes, the "
+                         "DiLoCo-style lever; numerics-changing, so "
+                         "never auto")
     ap.add_argument("--dcn-gbps", type=float, default=12.5,
                     help="assumed DCN GB/s for the modeled fraction when "
                          "no TPU DCN peak is attached")
@@ -121,24 +128,59 @@ def main() -> None:
             "y": rng.randn(k, batch, m).astype(np.float32),
         })
 
-    def timed_round(sync_period, outer):
+    compress = args.compress if args.compress != "off" else None
+
+    def timed_round(sync_period, outer, compress=None):
         strat = MultiSliceLocalSGD(
             mesh, sync_period, outer_lr=args.outer_lr,
-            outer_momentum=args.outer_momentum, outer=outer)
+            outer_momentum=args.outer_momentum, outer=outer,
+            compress=compress)
         state = make_state(strat)
         step = strat.make_train_step(loss_fn, donate=False)
         dt, state = time_steps(
             step, state, superbatch(strat, sync_period),
             warmup=2, steps=args.rounds, fence_key="loss")
-        return dt / args.rounds, strat, state
+        return dt / args.rounds, strat, state, step
 
     k = args.sync_period
-    t_on, strat, state = timed_round(k, "on")
-    t_off, _, _ = timed_round(k, "off")
-    t_sync1, _, _ = timed_round(1, "on")
+    t_on, strat, state, step_on = timed_round(k, "on", compress)
+    t_off, _, _, _ = timed_round(k, "off")
+    t_sync1, _, _, _ = timed_round(1, "on", compress)
 
     float_bytes = strat.outer_float_bytes(state)
-    sync_bytes = outer_sync_bytes(float_bytes, args.slices)
+    sync_bytes = outer_sync_bytes(float_bytes, args.slices,
+                                  compress=compress)
+    if compress:
+        # one shared-scale pmax (4 wire bytes, same ring formula) per
+        # compressed outer pmean that actually carries float state — the
+        # delta always, the inner-opt-state sync only when the optimizer
+        # has float slots (plain SGD has none)
+        import jax as _jax
+
+        from benchmarks.common import dp_allreduce_bytes
+
+        n_scales = sum(
+            1 for tree in (state.inner.params, state.inner.opt_state)
+            if any(getattr(getattr(l, "dtype", None), "kind", "") == "f"
+                   for l in _jax.tree.leaves(tree)))
+        sync_bytes += n_scales * dp_allreduce_bytes(4, args.slices)
+    # measured side of the byte model: re-trace the outer round under
+    # trace_comm and ring-adjust the recorded DCN payloads — modeled vs
+    # traced lands in the same JSON line
+    import jax
+
+    import distributed_tensorflow_guide_tpu.collectives as cc
+
+    # a FRESH jitted wrapper: the timed step's jaxpr is already cached
+    # for these avals, and a cache hit would skip the python body (and
+    # the wrappers) entirely, recording nothing
+    with cc.trace_comm() as rec:
+        jax.eval_shape(strat.make_train_step(loss_fn, donate=False),
+                       state, superbatch(strat, k))
+    dcn_frac = (args.slices - 1) / args.slices
+    traced_sync_bytes = sum(
+        2.0 * b * dcn_frac for key, b in rec.bytes.items()
+        if key.endswith("[dcn]"))
     exposed_measured = max(0.0, t_on - t_off) / t_on if t_on > 0 else 0.0
     peak = device_dcn_peak() or args.dcn_gbps * 1e9
     t_dcn_model = sync_bytes / peak
@@ -150,7 +192,9 @@ def main() -> None:
         slices=args.slices,
         state_mb=args.state_mb,
         outer_float_bytes=float_bytes,
+        compress=args.compress,
         outer_sync_bytes=round(sync_bytes, 1),
+        outer_sync_bytes_traced=round(traced_sync_bytes, 1),
         round_s_outer_on=round(t_on, 5),
         round_s_outer_off=round(t_off, 5),
         round_s_sync1=round(t_sync1, 5),

@@ -76,11 +76,12 @@ def main() -> None:
                          "only changed variable")
     ap.add_argument("--precision", default=None,
                     choices=["f32", "bf16", "bf16_remat",
-                             "bf16_remat_attn"],
+                             "bf16_remat_attn", "int8"],
                     help="mixed-precision policy (core/precision.py) "
                          "overriding this bench's per-config dtypes; "
-                         "bf16_remat_attn = checkpoint attention only. "
-                         "Echoed in the JSON when set")
+                         "bf16_remat_attn = checkpoint attention only, "
+                         "int8 = AQT-style STE training matmuls (f32 "
+                         "masters). Echoed in the JSON when set")
     ap.add_argument("--steps-per-call", type=int, default=1,
                     help="optimizer steps per compiled dispatch (lax.scan "
                          "inside the program; amortizes tunnel launch "

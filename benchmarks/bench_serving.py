@@ -84,6 +84,12 @@ def main() -> None:
                     default="model")
     ap.add_argument("--decode-impl", choices=["auto", "dense", "pallas"],
                     default="auto")
+    ap.add_argument("--weight-dtype", choices=["model", "int8", "int4"],
+                    default="model",
+                    help="projection-weight storage for BOTH serving "
+                         "sides (the A/B stays apples-to-apples): "
+                         "'int8'/'int4' serves per-column-quantized "
+                         "kernels with dequant fused into each matmul")
     ap.add_argument("--slo-ttft-x", type=float, default=10.0,
                     help="TTFT SLO as a multiple of unloaded TTFT")
     ap.add_argument("--slo-tpot-x", type=float, default=6.0,
@@ -126,6 +132,7 @@ def main() -> None:
 
     from distributed_tensorflow_guide_tpu.models.generation import (
         decode_cache_bytes_per_step,
+        decode_hbm_bytes_per_step,
         make_generate_fn,
         paged_decode_cache_bytes_per_step,
     )
@@ -150,14 +157,25 @@ def main() -> None:
         cfg = dataclasses.replace(gpt2_124m(), max_len=1024)
         plens, pmix = (64, 128, 256), (0.5, 0.3, 0.2)
         mnews, mmix = (64, 192), (0.6, 0.4)
+    wq = args.weight_dtype if args.weight_dtype != "model" else None
+    if wq and args.lora_rank:
+        raise SystemExit("--weight-dtype and --lora-rank are mutually "
+                         "exclusive (no f32 kernel for the deltas)")
     cfg = dataclasses.replace(
         cfg,
         kv_dtype="int8" if args.kv_dtype == "int8" else None,
-        decode_impl=args.decode_impl)
-    model = Transformer(cfg)
+        decode_impl=args.decode_impl,
+        weight_dtype=wq)
+    # init the f32 sibling, then quantize post-hoc (the checkpoint flow)
+    model = Transformer(dataclasses.replace(cfg, weight_dtype=None))
     params = jax.jit(model.init)(
         jax.random.PRNGKey(0),
         jnp.zeros((1, cfg.max_len), jnp.int32))["params"]
+    if wq:
+        from distributed_tensorflow_guide_tpu.ops import quant
+
+        params = quant.quantize_params(params, bits=8 if wq == "int8"
+                                       else 4)
 
     # multi-LoRA: the continuous side's config gains the delta banks;
     # the static baseline stays the base model (adapter 0 is bitwise
@@ -692,6 +710,11 @@ def main() -> None:
     extras = {
         "mode": args.mode,
         "kv_dtype": args.kv_dtype,
+        "weight_dtype": args.weight_dtype,
+        # leaf-driven over the (possibly quantized) tree: the params
+        # term shrinks ~4x/~8x under --weight-dtype int8/int4
+        "hbm_bytes_per_decode_step": decode_hbm_bytes_per_step(
+            cfg, params, args.slots),
         "decode_impl": cfg.resolve_decode_impl(),
         "prefill_chunk": args.prefill_chunk,
         "slots": args.slots,

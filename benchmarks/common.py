@@ -336,21 +336,42 @@ def device_dcn_peak() -> float | None:
 # failure, not a stale doc.
 
 
-def dp_allreduce_terms(grad_bytes: float, world: int) -> dict:
+def _wire_payload_bytes(payload_bytes: float, compress: str | None) -> float:
+    """The bytes a FLOAT32-denominated payload actually puts on the wire
+    under the gradient-compression knob: ``compress="int8"`` sends 1
+    byte/elem instead of 4 (ops/quant.int8_pmean — the per-bucket f32
+    scale side-channel is priced separately at the call sites, where the
+    bucket count is known)."""
+    if compress in (None, "off", "none"):
+        return float(payload_bytes)
+    if compress == "int8":
+        return float(payload_bytes) / 4.0
+    raise ValueError(
+        f"compress must be None/'off' or 'int8', got {compress!r}")
+
+
+def dp_allreduce_terms(grad_bytes: float, world: int,
+                       compress: str | None = None) -> dict:
     """Ring all-reduce split into its two one-way passes (each moves
-    (n−1)/n of the buffer per device)."""
+    (n−1)/n of the buffer per device). ``grad_bytes`` is always the FLOAT
+    gradient size; ``compress`` rescales it to the wire format."""
     if world <= 1:
         return {"reduce_scatter": 0.0, "all_gather": 0.0}
     frac = (world - 1) / world
-    return {"reduce_scatter": grad_bytes * frac,
-            "all_gather": grad_bytes * frac}
+    wire = _wire_payload_bytes(grad_bytes, compress)
+    return {"reduce_scatter": wire * frac,
+            "all_gather": wire * frac}
 
 
-def dp_allreduce_bytes(grad_bytes: float, world: int) -> float:
+def dp_allreduce_bytes(grad_bytes: float, world: int,
+                       compress: str | None = None) -> float:
     """Sync-DP gradient all-reduce: ring = reduce-scatter + all-gather,
     each moving (n−1)/n of the buffer per device — 2·P·(n−1)/n. Zero on a
-    1-device axis (lax.pmean compiles to a no-op there)."""
-    return sum(dp_allreduce_terms(grad_bytes, world).values())
+    1-device axis (lax.pmean compiles to a no-op there).
+    ``compress="int8"`` prices the int8 wire format (P/4); callers add
+    ``n_buckets * dp_allreduce_bytes(4, world)`` for the shared-scale
+    pmax side-channel."""
+    return sum(dp_allreduce_terms(grad_bytes, world, compress).values())
 
 
 def fsdp_comm_terms(sharded_param_bytes: float, world: int,
@@ -403,13 +424,17 @@ def pipeline_ppermute_bytes(act_bytes: float, num_microbatches: int,
         act_bytes, num_microbatches, stages).values())
 
 
-def outer_sync_terms(float_state_bytes: float, n_slices: int) -> dict:
-    """Outer DCN ring all-reduce split into its two one-way passes."""
+def outer_sync_terms(float_state_bytes: float, n_slices: int,
+                     compress: str | None = None) -> dict:
+    """Outer DCN ring all-reduce split into its two one-way passes.
+    ``float_state_bytes`` is always the f32 state size; ``compress``
+    rescales it to the wire format (int8 = 1 byte/elem)."""
     if n_slices <= 1:
         return {"reduce_scatter": 0.0, "all_gather": 0.0}
     frac = (n_slices - 1) / n_slices
-    return {"reduce_scatter": float_state_bytes * frac,
-            "all_gather": float_state_bytes * frac}
+    wire = _wire_payload_bytes(float_state_bytes, compress)
+    return {"reduce_scatter": wire * frac,
+            "all_gather": wire * frac}
 
 
 def moe_all_to_all_bytes(dispatch_buffer_bytes: float,
@@ -428,7 +453,8 @@ def moe_all_to_all_bytes(dispatch_buffer_bytes: float,
             * (expert_world - 1) / expert_world)
 
 
-def outer_sync_bytes(float_state_bytes: float, n_slices: int) -> float:
+def outer_sync_bytes(float_state_bytes: float, n_slices: int,
+                     compress: str | None = None) -> float:
     """Two-tier outer sync (parallel/multislice.py): the per-round DCN
     traffic per participating device. The outer collective is a ring
     all-reduce ACROSS SLICES of the float param delta + float inner
@@ -436,8 +462,12 @@ def outer_sync_bytes(float_state_bytes: float, n_slices: int) -> float:
     :func:`dp_allreduce_bytes`, with n = the slice count and P = the float
     state bytes (``MultiSliceLocalSGD.outer_float_bytes``). Zero at one
     slice (the pmean compiles to a no-op). Divide by ``sync_period``
-    inner steps for the amortized per-step DCN load."""
-    return sum(outer_sync_terms(float_state_bytes, n_slices).values())
+    inner steps for the amortized per-step DCN load. ``compress="int8"``
+    prices the int8 wire format (P/4); add ``2 * dp_allreduce_bytes(4,
+    n_slices)`` for the two shared-scale pmax scalars (delta +
+    opt-state)."""
+    return sum(outer_sync_terms(float_state_bytes, n_slices,
+                                compress).values())
 
 
 def dcn_extras(comm_bytes: float, comm_secs: float | None = None,

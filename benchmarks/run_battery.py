@@ -104,10 +104,16 @@ BATTERY: list[tuple[str, list[str], int]] = [
     # argv-identical except the one knob
     ("comm_overlap_dp",
      ["benchmarks/bench_comm_overlap.py", "--mode", "dp", "--tune",
-      "--overlap", "off"], 1800),
+      "--overlap", "off", "--compress", "off"], 1800),
     ("dp_overlap_kernel",
      ["benchmarks/bench_comm_overlap.py", "--mode", "dp", "--tune",
-      "--overlap", "on"], 1800),
+      "--overlap", "on", "--compress", "off"], 1800),
+    # int8-compressed gradient all-reduce (round 19): argv-identical to
+    # dp_overlap_kernel except the wire representation — quarter the
+    # grad bytes on the bucket seams + a 4-byte scale pmax per bucket
+    ("dp_overlap_int8",
+     ["benchmarks/bench_comm_overlap.py", "--mode", "dp", "--tune",
+      "--overlap", "on", "--compress", "int8"], 1800),
     ("comm_overlap_fsdp",
      ["benchmarks/bench_comm_overlap.py", "--mode", "fsdp",
       "--fsdp-prefetch", "off"], 1800),
@@ -124,23 +130,38 @@ BATTERY: list[tuple[str, list[str], int]] = [
     # tuned KV block.
     ("gpt2_decode",
      ["benchmarks/bench_generate.py", "--kv-dtype", "model",
-      "--decode-impl", "dense", "--spec-draft-layers", "0"], 1800),
+      "--decode-impl", "dense", "--spec-draft-layers", "0",
+      "--weight-dtype", "model"], 1800),
     # decode-roofline A/B: scan unroll (the donation default is already on)
     ("gpt2_decode_unroll4",
      ["benchmarks/bench_generate.py", "--kv-dtype", "model",
       "--decode-impl", "dense", "--spec-draft-layers", "0",
-      "--unroll", "4"], 1800),
+      "--weight-dtype", "model", "--unroll", "4"], 1800),
     # one-variable lever rows vs the continuity row: quantized cache,
     # length-aware Pallas decode-attend, self-speculative decoding
     ("gpt2_decode_kv_int8",
      ["benchmarks/bench_generate.py", "--kv-dtype", "int8",
-      "--decode-impl", "dense", "--spec-draft-layers", "0"], 1800),
+      "--decode-impl", "dense", "--spec-draft-layers", "0",
+      "--weight-dtype", "model"], 1800),
+    # weight-only quantized decode (round 19): per-column int8 / packed
+    # int4 kernels with fused dequant — argv-identical to gpt2_decode
+    # except the one knob; the params term of the roofline drops ~4x/~8x
+    ("gpt2_decode_wq8",
+     ["benchmarks/bench_generate.py", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--spec-draft-layers", "0",
+      "--weight-dtype", "int8"], 1800),
+    ("gpt2_decode_wq4",
+     ["benchmarks/bench_generate.py", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--spec-draft-layers", "0",
+      "--weight-dtype", "int4"], 1800),
     ("gpt2_decode_pallas",
      ["benchmarks/bench_generate.py", "--kv-dtype", "model",
-      "--decode-impl", "pallas", "--spec-draft-layers", "0"], 1800),
+      "--decode-impl", "pallas", "--spec-draft-layers", "0",
+      "--weight-dtype", "model"], 1800),
     ("gpt2_decode_spec",
      ["benchmarks/bench_generate.py", "--kv-dtype", "model",
-      "--decode-impl", "dense", "--spec-draft-layers", "4"], 1800),
+      "--decode-impl", "dense", "--spec-draft-layers", "4",
+      "--weight-dtype", "model"], 1800),
     # serving-under-load rows (PR 10): the continuity row is STATIC
     # batching with every lever pinned off; each row below flips exactly
     # one knob against its neighbour (static->continuous batching,
@@ -151,33 +172,35 @@ BATTERY: list[tuple[str, list[str], int]] = [
     ("serve_continuity",
      ["benchmarks/bench_serving.py", "--mode", "static",
       "--prefill-chunk", "32", "--kv-dtype", "model",
-      "--decode-impl", "dense"], 1800),
+      "--decode-impl", "dense", "--weight-dtype", "model"], 1800),
     ("serve_paged",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
-      "--decode-impl", "dense"], 1800),
+      "--decode-impl", "dense", "--weight-dtype", "model"], 1800),
     ("serve_chunked_prefill",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "8", "--kv-dtype", "model",
-      "--decode-impl", "dense"], 1800),
+      "--decode-impl", "dense", "--weight-dtype", "model"], 1800),
     ("serve_kv_int8",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "int8",
-      "--decode-impl", "dense"], 1800),
+      "--decode-impl", "dense", "--weight-dtype", "model"], 1800),
     ("serve_pallas",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
-      "--decode-impl", "pallas"], 1800),
+      "--decode-impl", "pallas", "--weight-dtype", "model"], 1800),
     # serving under fire (PR 11): one knob each — serve_paged + the
     # chaos storm, then + the mid-run kill/snapshot-restore leg
     ("serve_chaos",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
-      "--decode-impl", "dense", "--chaos"], 1800),
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--chaos"], 1800),
     ("serve_snapshot_restore",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
-      "--decode-impl", "dense", "--chaos", "--snapshot-restore"], 1800),
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--chaos", "--snapshot-restore"], 1800),
     # prefix sharing + tenancy (PR 12): one knob each — chunked prefill
     # + the prefix-mix phase (prefix cache ON vs OFF in one run), the
     # same under chunking-off geometry (tenancy/fair-share focus), then
@@ -185,16 +208,18 @@ BATTERY: list[tuple[str, list[str], int]] = [
     ("serve_prefix_cache",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "8", "--kv-dtype", "model",
-      "--decode-impl", "dense", "--prefix-mix", "3"], 1800),
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--prefix-mix", "3"], 1800),
     ("serve_multi_tenant",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
-      "--decode-impl", "dense", "--prefix-mix", "4"], 1800),
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--prefix-mix", "4"], 1800),
     ("serve_lora",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
-      "--decode-impl", "dense", "--prefix-mix", "3",
-      "--lora-rank", "2"], 1800),
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--prefix-mix", "3", "--lora-rank", "2"], 1800),
     ("ring_attention_1024",
      ["benchmarks/bench_ring_attention.py", "--seq-len", "1024"], 1500),
     ("ring_attention_2048",
@@ -231,20 +256,27 @@ BATTERY: list[tuple[str, list[str], int]] = [
     # CPU over the multiprocess runner, like the resilience rows.
     ("dcn_hybrid",
      ["benchmarks/bench_dcn_hybrid.py", "--slices", "2", "--sync-period",
-      "8", "--outer-momentum", "0.9", "--elastic", "on", "--seed", "0"],
-     1800),
+      "8", "--outer-momentum", "0.9", "--elastic", "on", "--seed", "0",
+      "--compress", "off"], 1800),
     ("dcn_hybrid_sync1",
      ["benchmarks/bench_dcn_hybrid.py", "--slices", "2", "--sync-period",
-      "1", "--outer-momentum", "0.9", "--elastic", "off", "--seed", "0"],
-     1200),
+      "1", "--outer-momentum", "0.9", "--elastic", "off", "--seed", "0",
+      "--compress", "off"], 1200),
     ("dcn_hybrid_sync8",
      ["benchmarks/bench_dcn_hybrid.py", "--slices", "2", "--sync-period",
-      "8", "--outer-momentum", "0.9", "--elastic", "off", "--seed", "0"],
-     1200),
+      "8", "--outer-momentum", "0.9", "--elastic", "off", "--seed", "0",
+      "--compress", "off"], 1200),
     ("dcn_hybrid_sync64",
      ["benchmarks/bench_dcn_hybrid.py", "--slices", "2", "--sync-period",
-      "64", "--outer-momentum", "0.9", "--elastic", "off", "--seed", "0"],
-     1200),
+      "64", "--outer-momentum", "0.9", "--elastic", "off", "--seed", "0",
+      "--compress", "off"], 1200),
+    # int8-compressed outer sync (round 19): argv-identical to
+    # dcn_hybrid_sync8 except the wire representation — the DiLoCo-style
+    # lever quarters outer_sync_bytes on the slow DCN tier
+    ("dcn_hybrid_int8_outer",
+     ["benchmarks/bench_dcn_hybrid.py", "--slices", "2", "--sync-period",
+      "8", "--outer-momentum", "0.9", "--elastic", "off", "--seed", "0",
+      "--compress", "int8"], 1200),
     ("native_input", ["benchmarks/bench_native_input.py"], 1200),
     ("resnet_native_input",
      ["benchmarks/bench_resnet_native_input.py"], 1800),

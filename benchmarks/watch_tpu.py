@@ -42,6 +42,10 @@ FIRST_WINDOW = [
     "gpt2_decode_kv_int8",     # one-variable lever rows (round 11)
     "gpt2_decode_pallas",
     "gpt2_decode_spec",
+    "gpt2_decode_wq8",         # weight-only quantized decode (round 19)
+    "gpt2_decode_wq4",
+    "dp_overlap_int8",         # int8-compressed grad all-reduce (rnd 19)
+    "dcn_hybrid_int8_outer",   # int8-compressed outer DCN sync (rnd 19)
     "serve_continuity",        # serving A/B (PR 10): static baseline,
     "serve_paged",             # continuous batching + paged KV,
     "serve_chunked_prefill",   # + chunked prefill interleave

@@ -122,6 +122,13 @@ class ProgramContract:
     #: quantitative promises (round 17); None = observe-only — the cost
     #: vector is still derived and fingerprinted, just not pinned.
     cost: CostSpec | None = None
+    #: opt-in for integer matmul operands (round 19): quantized programs
+    #: (weight-only decode, AQT training steps) legally contract int8
+    #: operands — but ONLY int8, only into an int32 accumulator, and the
+    #: result must be rescaled by an f32 scale (the dequant chain the
+    #: precision rule walks). Default False: an integer dot in any other
+    #: program is a finding, not a silent pass.
+    quantized_matmuls: bool = False
 
 
 _REGISTRY: dict[str, ProgramContract] = {}

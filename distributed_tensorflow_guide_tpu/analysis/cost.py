@@ -22,6 +22,12 @@ instead of a stale doc. Four quantities per program:
   sort/top_k, pallas) touch HBM; elementwise/shape/convert chains are
   assumed XLA-fused (zero traffic), which makes this MINIMAL algorithmic
   traffic exactly like the closed forms it is diffed against.
+  Matmul operand reads are *narrow-origin* aware (round 19): a
+  ``convert_element_type`` chain carries the smallest storage the value
+  ever had, so a weight stored int8 and upcast inside the fused matmul
+  is charged 1 byte/elem — the widening cast is compute, not traffic.
+  Narrowing converts (f32 -> bf16) are the identity under the ``min``,
+  so every pre-existing program's bytes are unchanged.
   Gather charges the *touched rows* (output size), not the whole table —
   the ``decode_hbm_bytes_per_step`` "gathered embedding rows" convention
   — and ``dynamic_update_slice`` charges the update size, in-place.
@@ -219,13 +225,21 @@ _SCAN, _WHILE = "scan", "while"
 _BRANCH_PRIMS = frozenset({"cond", "switch", "platform_index"})
 
 
-def _eqn_hbm(eqn) -> tuple[float, float]:
+def _eqn_hbm(eqn, narrow: dict[int, int] | None = None,
+             ) -> tuple[float, float]:
     """(read, write) bytes one memory-bound equation moves; (0, 0) for
-    fused-class equations."""
+    fused-class equations. ``narrow`` maps ``id(var)`` to the smallest
+    storage bytes the value had anywhere on its convert chain — applied
+    ONLY to matmul operand reads (the weight-only-quant case: the int8
+    buffer in HBM is what the MXU pipeline actually streams; the f32
+    upcast lives in registers)."""
     name = eqn.primitive.name
     in_b = sum(_aval_bytes(v.aval) for v in eqn.invars)
     out_b = sum(_aval_bytes(v.aval) for v in eqn.outvars)
     if name in _MATMUL_PRIMS or name in _REORDER_PRIMS:
+        if name in _MATMUL_PRIMS and narrow:
+            in_b = sum(narrow.get(id(v), _aval_bytes(v.aval))
+                       for v in eqn.invars)
         return float(in_b), float(out_b)
     if name in _TOUCHED_ROWS_PRIMS:
         # the touched rows, not the whole table (decode counts GATHERED
@@ -286,8 +300,21 @@ def _interpret(jaxpr, vec: CostVector, *, mult: float,
     """Accumulate ``jaxpr``'s costs into ``vec`` with multiplier ``mult``
     (scan trip counts compose multiplicatively through nesting)."""
     jaxpr = walker._as_open_jaxpr(jaxpr)
+    # narrow-origin storage bytes, per jaxpr: convert_element_type chains
+    # carry min(chain, own aval) forward — monotone, so a pure-widening
+    # chain (int8 weight -> f32 matmul operand) remembers the 1-byte HBM
+    # buffer it streams from, while narrowing (f32 -> bf16) is a no-op
+    # relative to the plain aval bytes. Chain-breaking ops (the int4
+    # unpack's shifts/concats) deliberately reset to aval bytes: once the
+    # program *computes* a wider value, that value is what moves.
+    narrow: dict[int, int] = {}
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
+        if name == "convert_element_type" and eqn.invars and eqn.outvars:
+            inv, outv = eqn.invars[0], eqn.outvars[0]
+            narrow[id(outv)] = min(
+                narrow.get(id(inv), _aval_bytes(inv.aval)),
+                _aval_bytes(outv.aval))
         if name == "pallas_call":
             cost = _pallas_cost(eqn)
             vec.flops += cost.get("flops", 0.0) * mult
@@ -299,7 +326,7 @@ def _interpret(jaxpr, vec: CostVector, *, mult: float,
             vec.flops += (_dot_general_flops(eqn) if name == "dot_general"
                           else _conv_flops(eqn)) * mult
         if not flops_only:
-            r, w = _eqn_hbm(eqn)
+            r, w = _eqn_hbm(eqn, narrow)
             vec.hbm_bytes_read += r * mult
             vec.hbm_bytes_written += w * mult
             cname = walker.prim_name(eqn)

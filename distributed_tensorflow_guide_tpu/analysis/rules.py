@@ -131,6 +131,32 @@ def _reduced_elems_dot(eqn) -> int:
     return n
 
 
+def _int_dot_dequant_ok(eqn, consumers) -> bool:
+    """Walk the dequant chain of one integer dot: its int32 accumulator
+    must reach a ``mul`` whose other operand is f32 — the scale — possibly
+    through converts (``acc.astype(f32) * scale`` is the idiom both
+    ops/quant paths emit). A quantized product that is never rescaled is
+    numerically meaningless output, not an optimization."""
+    import jax.numpy as jnp
+
+    var = eqn.outvars[0]
+    for _ in range(4):  # tolerate a short convert/reshape chain
+        for c in consumers.get(id(var), ()):
+            cname = c.primitive.name
+            if cname == "mul":
+                others = [v for v in c.invars if v is not var]
+                return any(
+                    hasattr(v, "aval")
+                    and jnp.dtype(v.aval.dtype) == jnp.dtype(jnp.float32)
+                    for v in others)
+            if cname in ("convert_element_type", "reshape", "broadcast_in_dim"):  # noqa: E501
+                var = c.outvars[0]
+                break
+        else:
+            return False
+    return False
+
+
 def rule_precision(traced: TracedProgram,
                    contract: ProgramContract) -> RuleReport:
     import jax.numpy as jnp
@@ -141,12 +167,61 @@ def rule_precision(traced: TracedProgram,
     compute = jnp.dtype(policy.compute_dtype)
     accum = jnp.dtype(policy.accum_dtype)
     observed: dict = {"policy": policy.name, "matmuls": 0,
-                      "bad_operand_matmuls": 0, "bad_accum_ops": 0}
+                      "bad_operand_matmuls": 0, "bad_accum_ops": 0,
+                      "int_matmuls": 0}
     findings = []
-    for eqn in walker.walk(traced.jaxpr):
+    eqns = list(walker.walk(traced.jaxpr))
+    # var -> consuming eqns (vars are per-jaxpr objects, so identity keys
+    # are exact; a dot and its dequant chain share one enclosing jaxpr)
+    consumers: dict[int, list] = {}
+    for eqn in eqns:
+        for v in eqn.invars:
+            if hasattr(v, "aval"):
+                consumers.setdefault(id(v), []).append(eqn)
+    for eqn in eqns:
         name = eqn.primitive.name
         if name in _MATMUL_PRIMS:
             observed["matmuls"] += 1
+            int_dtypes = {jnp.dtype(v.aval.dtype) for v in eqn.invars
+                          if jnp.issubdtype(v.aval.dtype, jnp.integer)}
+            if int_dtypes:
+                observed["int_matmuls"] += 1
+                if not contract.quantized_matmuls:
+                    findings.append(Finding(
+                        "precision",
+                        f"{name} with integer operands "
+                        f"{sorted(d.name for d in int_dtypes)} in a program "
+                        "whose contract does not opt in via "
+                        "quantized_matmuls",
+                        expected="float operands (or "
+                                 "contract.quantized_matmuls=True)",
+                        observed=sorted(d.name for d in int_dtypes)))
+                else:
+                    if int_dtypes != {jnp.dtype(jnp.int8)}:
+                        findings.append(Finding(
+                            "precision",
+                            f"quantized {name} operands must be int8, got "
+                            f"{sorted(d.name for d in int_dtypes)}",
+                            expected="int8",
+                            observed=sorted(d.name for d in int_dtypes)))
+                    out = jnp.dtype(eqn.outvars[0].aval.dtype)
+                    if out != jnp.dtype(jnp.int32):
+                        findings.append(Finding(
+                            "precision",
+                            f"quantized {name} accumulates in {out.name} — "
+                            "int8 contractions must widen to int32 (set "
+                            "preferred_element_type), the integer analog "
+                            "of the accum-dtype contract",
+                            expected="int32", observed=out.name))
+                    elif not _int_dot_dequant_ok(eqn, consumers):
+                        findings.append(Finding(
+                            "precision",
+                            f"quantized {name} result is never rescaled by "
+                            "an f32 scale (no dequant mul found on its "
+                            "accumulator)",
+                            expected="acc.astype(f32) * f32_scale",
+                            observed="no f32 mul in the consumer chain"))
+                continue  # the float checks below don't apply to int dots
             op_dtypes = {jnp.dtype(v.aval.dtype) for v in eqn.invars
                          if jnp.issubdtype(v.aval.dtype, jnp.floating)}
             bad = op_dtypes - {compute}

@@ -49,6 +49,12 @@ class Policy:
     compute_dtype: Any = jnp.bfloat16
     accum_dtype: Any = jnp.float32
     remat: str = "none"
+    # AQT-style int8 matmuls (ops/quant.int8_ste_dot): the projection
+    # contractions quantize both operands per-tensor dynamically each
+    # step, run int8 x int8 -> int32, and backpropagate straight-through.
+    # Params stay f32 masters (param_dtype), the head/loss stays
+    # accum_dtype — only the MXU-bound dots change representation.
+    quantized_matmuls: bool = False
 
     def __post_init__(self):
         if self.remat not in REMAT_MODES:
@@ -58,12 +64,14 @@ class Policy:
     def apply_to_transformer(self, cfg):
         """A TransformerConfig re-expressed under this policy: activation
         dtype = compute_dtype, remat mode threaded through ``remat_mode``
-        (with the legacy bool kept consistent for old call sites)."""
+        (with the legacy bool kept consistent for old call sites), int8
+        training matmuls through ``quantized_matmuls``."""
         import dataclasses as _dc
 
         return _dc.replace(
             cfg, dtype=self.compute_dtype,
-            remat=self.remat == "block", remat_mode=self.remat)
+            remat=self.remat == "block", remat_mode=self.remat,
+            quantized_matmuls=self.quantized_matmuls)
 
 
 PRESETS: dict[str, Policy] = {
@@ -76,6 +84,13 @@ PRESETS: dict[str, Policy] = {
     # + attention-only checkpointing: recompute the high-traffic sub-layer,
     # keep the MLP activations resident — the middle of the HBM/FLOP trade
     "bf16_remat_attn": Policy("bf16_remat_attn", remat="attention"),
+    # AQT-style int8 training matmuls: f32 masters, f32 non-matmul compute
+    # (so CPU parity runs isolate the quantizer — the only delta vs "f32"
+    # is the int8 contraction), per-tensor dynamic scales, straight-through
+    # gradients. The loss-parity pins in tests/test_quant.py train this
+    # preset against "f32" step-for-step.
+    "int8": Policy("int8", compute_dtype=jnp.float32,
+                   quantized_matmuls=True),
 }
 
 

@@ -143,7 +143,11 @@ def decode_hbm_bytes_per_step(cfg: TransformerConfig, params,
     the length-aware kernel so the denominator stays honest either way).
     Decode is bandwidth-bound — this is the roofline denominator
     ``benchmarks/bench_generate.py`` reports ``hbm_gb_per_s`` against.
-    ``params`` may be arrays or the eval_shape tree (sizes/dtypes only)."""
+    ``params`` may be arrays or the eval_shape tree (sizes/dtypes only).
+    Leaf-driven by construction, so weight-only quantization needs no
+    special case: hand it the ``ops.quant.quantize_params`` tree and the
+    params term shrinks with the stored bytes — ~4x for int8 qkernels,
+    ~8x for int4 packed two-per-byte (scales are d_out-sized noise)."""
     import numpy as np
 
     p_bytes = sum(

@@ -128,6 +128,26 @@ class TransformerConfig:
     # or a gumbel comparison).
     lora_rank: int | None = None
     lora_adapters: int = 0
+    # Weight-only quantized serving (decode mode only): "int8" / "int4"
+    # store every projection kernel (attention qkv/proj, MLP up/down,
+    # lm_head) quantized per-OUTPUT-channel with f32 scales — the param
+    # tree carries {qkernel, scale} where the f32 model has {kernel}
+    # (ops/quant.quantize_params is the tree transform) — and the dequant
+    # is FUSED into each matmul (scale on the output columns, never a
+    # materialized f32 kernel copy: the int8-KV discipline applied to the
+    # weights). Cuts the params term of decode_hbm_bytes_per_step ~4x
+    # (int8) / ~8x (int4-packed, two nibbles per byte). Embeddings and
+    # LayerNorms stay full precision (gathered, never streamed). None
+    # (default) keeps every historical trace byte-identical.
+    weight_dtype: str | None = None
+    # AQT-style int8 TRAINING matmuls (core/precision.py PRESETS["int8"]):
+    # the projection contractions run int8 x int8 -> int32 with per-tensor
+    # dynamic scales and straight-through gradients (ops/quant.
+    # int8_ste_dot); params stay f32 masters with the IDENTICAL tree and
+    # init draws as the unquantized model (loss-parity pins). lm_head and
+    # the classifier keep full-precision accumulation. Training-side only
+    # — decode uses ``weight_dtype``.
+    quantized_matmuls: bool = False
 
     def __post_init__(self):
         if self.attn_impl not in ("auto", "dense", "flash"):
@@ -175,6 +195,37 @@ class TransformerConfig:
                     f"(got {self.lora_adapters})")
         elif self.lora_adapters:
             raise ValueError("lora_adapters requires lora_rank")
+        if self.weight_dtype not in (None, "int8", "int4"):
+            raise ValueError(
+                "weight_dtype must be None, 'int8' or 'int4', "
+                f"got {self.weight_dtype!r}"
+            )
+        if self.weight_dtype is not None:
+            # NOT decode-gated: the serving flow attaches weight_dtype to
+            # the training-view config and decode_config() flips decode
+            # later; the training-side exclusion is quantized_matmuls.
+            if self.quantized_matmuls:
+                raise ValueError(
+                    "weight_dtype (decode-side) and quantized_matmuls "
+                    "(training-side) are mutually exclusive"
+                )
+            if self.lora_rank is not None:
+                raise ValueError(
+                    "weight_dtype and lora_rank are mutually exclusive "
+                    "(the quantized projections have no f32 kernel for "
+                    "the deltas to ride on)"
+                )
+        if self.quantized_matmuls:
+            if self.decode:
+                raise ValueError(
+                    "quantized_matmuls is the training lever; decode-side "
+                    "quantization is weight_dtype"
+                )
+            if self.lora_rank is not None:
+                raise ValueError(
+                    "quantized_matmuls and lora_rank are mutually "
+                    "exclusive"
+                )
 
     @property
     def paged(self) -> bool:
@@ -295,6 +346,103 @@ def _lora_delta(a, b, x: jax.Array, adapter: jax.Array) -> jax.Array:
     return jnp.einsum("bcr,bre->bce", t, b_e)
 
 
+_WQ_BITS = {"int8": 8, "int4": 4}
+
+
+def _prod(dims) -> int:
+    out = 1
+    for d in dims:
+        out *= int(d)
+    return out
+
+
+class WeightQuantDense(nn.Module):
+    """Weight-only quantized projection (``cfg.weight_dtype``, decode).
+
+    Declares the serving-side param layout directly — ``qkernel`` (int8,
+    or int4 packed two-per-byte into uint8) plus per-output-column f32
+    ``scale`` — exactly what ``ops.quant.quantize_params`` produces from
+    the f32 sibling's ``kernel``, under the SAME module name, so the
+    quantized tree drops straight into ``model.apply``. The dequant is
+    fused into the matmul (``ops.quant.wq_matmul``): the int cast rides
+    the contraction and the scale lands on the output columns, so no
+    dequantized kernel copy is ever materialized (pinned by the jaxpr
+    walk in tests/test_quant.py). Init values (zeros/ones) are
+    placeholders — real weights always arrive via ``quantize_params``.
+    """
+
+    features: tuple
+    in_axes: int = 1
+    bits: int = 8
+    dtype: Dtype = jnp.float32
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        feats = tuple(self.features)
+        d_in = _prod(x.shape[-self.in_axes:])
+        out_flat = _prod(feats)
+        if self.bits == 4:
+            if d_in % 2:
+                raise ValueError(
+                    f"int4 packing needs an even fan-in, got {d_in}")
+            rows, store = d_in // 2, jnp.uint8
+        else:
+            rows, store = d_in, jnp.int8
+        qkernel = self.param("qkernel", nn.initializers.zeros_init(),
+                             (rows, out_flat), store)
+        scale = self.param("scale", nn.initializers.ones_init(),
+                           (out_flat,), jnp.float32)
+        from distributed_tensorflow_guide_tpu.ops import quant
+
+        xf = x.reshape(x.shape[:-self.in_axes] + (d_in,)).astype(self.dtype)
+        y = quant.wq_matmul(xf, qkernel, scale, bits=self.bits,
+                            dtype=self.dtype)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros_init(),
+                              (out_flat,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y.reshape(x.shape[:-self.in_axes] + feats)
+
+
+class QuantTrainDense(nn.Module):
+    """AQT-style int8 training projection (``cfg.quantized_matmuls``).
+
+    Param-tree transparent: declares the SAME ``kernel`` (and optional
+    ``bias``) — names, shapes, f32 param dtype, initializers — as the
+    ``nn.Dense``/``nn.DenseGeneral`` it replaces, and flax derives init
+    RNG from the param path, so the init draws are bit-identical to the
+    unquantized model (the basis of the loss-parity pins). Only the
+    contraction changes: ``ops.quant.int8_ste_dot`` quantizes both
+    operands per-tensor dynamically each step, accumulates int8 x int8 in
+    int32, rescales in f32, and backpropagates straight-through.
+    """
+
+    features: tuple
+    in_axes: int = 1
+    dtype: Dtype = jnp.float32
+    kernel_init: Any = None
+    use_bias: bool = False
+    bias_init: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        feats = tuple(self.features)
+        in_shape = tuple(x.shape[-self.in_axes:])
+        d_in = _prod(in_shape)
+        kernel = self.param("kernel", self.kernel_init, in_shape + feats,
+                            jnp.float32)
+        from distributed_tensorflow_guide_tpu.ops import quant
+
+        xf = x.reshape(x.shape[:-self.in_axes] + (d_in,)).astype(self.dtype)
+        k2d = kernel.astype(self.dtype).reshape(d_in, -1)
+        y = quant.int8_ste_dot(xf, k2d).astype(self.dtype)
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, feats, jnp.float32)
+            y = y + bias.reshape(-1).astype(self.dtype)
+        return y.reshape(x.shape[:-self.in_axes] + feats)
+
+
 class MultiHeadAttention(nn.Module):
     cfg: TransformerConfig
 
@@ -305,14 +453,27 @@ class MultiHeadAttention(nn.Module):
         h, hd = cfg.num_heads, cfg.head_dim
         if cfg.tp_axis:  # Megatron f: identity fwd, psum bwd (see tp_axis doc)
             x = tp_identity(x, cfg.tp_axis)
-        qkv = nn.DenseGeneral(
-            (3, h, hd),
-            axis=-1,
-            dtype=cfg.dtype,
-            kernel_init=_dense_init("embed", "qkv", "heads", "kv"),
-            use_bias=False,
-            name="qkv",
-        )(x)
+        if cfg.weight_dtype:
+            qkv = WeightQuantDense(
+                (3, h, hd), in_axes=1, bits=_WQ_BITS[cfg.weight_dtype],
+                dtype=cfg.dtype, name="qkv",
+            )(x)
+        elif cfg.quantized_matmuls:
+            qkv = QuantTrainDense(
+                (3, h, hd), in_axes=1, dtype=cfg.dtype,
+                kernel_init=_dense_init("embed", "qkv", "heads", "kv"),
+                name="qkv",
+            )(x)
+        else:
+            # the historical call, kept verbatim
+            qkv = nn.DenseGeneral(
+                (3, h, hd),
+                axis=-1,
+                dtype=cfg.dtype,
+                kernel_init=_dense_init("embed", "qkv", "heads", "kv"),
+                use_bias=False,
+                name="qkv",
+            )(x)
         if cfg.lora:
             qkv_a, qkv_b = _lora_bank(self, cfg, "qkv",
                                       cfg.d_model, 3 * h * hd)
@@ -355,14 +516,27 @@ class MultiHeadAttention(nn.Module):
             ).astype(cfg.dtype)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         proj_in = out
-        out = nn.DenseGeneral(
-            cfg.d_model,
-            axis=(-2, -1),
-            dtype=cfg.dtype,
-            kernel_init=_dense_init("heads", "kv", "embed"),
-            use_bias=False,
-            name="proj",
-        )(out)
+        if cfg.weight_dtype:
+            out = WeightQuantDense(
+                (cfg.d_model,), in_axes=2, bits=_WQ_BITS[cfg.weight_dtype],
+                dtype=cfg.dtype, name="proj",
+            )(out)
+        elif cfg.quantized_matmuls:
+            out = QuantTrainDense(
+                (cfg.d_model,), in_axes=2, dtype=cfg.dtype,
+                kernel_init=_dense_init("heads", "kv", "embed"),
+                name="proj",
+            )(out)
+        else:
+            # the historical call, kept verbatim
+            out = nn.DenseGeneral(
+                cfg.d_model,
+                axis=(-2, -1),
+                dtype=cfg.dtype,
+                kernel_init=_dense_init("heads", "kv", "embed"),
+                use_bias=False,
+                name="proj",
+            )(out)
         if cfg.lora:
             proj_a, proj_b = _lora_bank(self, cfg, "proj",
                                         h * hd, cfg.d_model)
@@ -648,15 +822,32 @@ class MLP(nn.Module):
         cfg = self.cfg
         if cfg.tp_axis:  # Megatron f
             x = tp_identity(x, cfg.tp_axis)
-        y = nn.Dense(
-            cfg.d_ff,
-            dtype=cfg.dtype,
-            kernel_init=_dense_init("embed", "mlp"),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), ("mlp",)
-            ),
-            name="up",
-        )(x)
+        if cfg.weight_dtype:
+            y = WeightQuantDense(
+                (cfg.d_ff,), in_axes=1, bits=_WQ_BITS[cfg.weight_dtype],
+                dtype=cfg.dtype, use_bias=True, name="up",
+            )(x)
+        elif cfg.quantized_matmuls:
+            y = QuantTrainDense(
+                (cfg.d_ff,), in_axes=1, dtype=cfg.dtype,
+                kernel_init=_dense_init("embed", "mlp"),
+                use_bias=True,
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("mlp",)
+                ),
+                name="up",
+            )(x)
+        else:
+            # the historical call, kept verbatim
+            y = nn.Dense(
+                cfg.d_ff,
+                dtype=cfg.dtype,
+                kernel_init=_dense_init("embed", "mlp"),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("mlp",)
+                ),
+                name="up",
+            )(x)
         if cfg.lora:
             up_a, up_b = _lora_bank(self, cfg, "up", cfg.d_model, cfg.d_ff)
             if adapter is not None:
@@ -664,13 +855,26 @@ class MLP(nn.Module):
         y = nn.gelu(y)
         y = _constrain(y, ("batch", "seq_inner", "mlp"))
         down_in = y
-        y = nn.Dense(
-            cfg.d_model,
-            dtype=cfg.dtype,
-            kernel_init=_dense_init("mlp", "embed"),
-            use_bias=False,
-            name="down",
-        )(y)
+        if cfg.weight_dtype:
+            y = WeightQuantDense(
+                (cfg.d_model,), in_axes=1, bits=_WQ_BITS[cfg.weight_dtype],
+                dtype=cfg.dtype, name="down",
+            )(y)
+        elif cfg.quantized_matmuls:
+            y = QuantTrainDense(
+                (cfg.d_model,), in_axes=1, dtype=cfg.dtype,
+                kernel_init=_dense_init("mlp", "embed"),
+                name="down",
+            )(y)
+        else:
+            # the historical call, kept verbatim
+            y = nn.Dense(
+                cfg.d_model,
+                dtype=cfg.dtype,
+                kernel_init=_dense_init("mlp", "embed"),
+                use_bias=False,
+                name="down",
+            )(y)
         if cfg.lora:
             down_a, down_b = _lora_bank(self, cfg, "down",
                                         cfg.d_ff, cfg.d_model)
@@ -787,13 +991,24 @@ class Transformer(nn.Module):
             return nn.Dense(
                 cfg.num_classes, dtype=jnp.float32, name="classifier"
             )(cls)
-        logits = nn.Dense(
-            cfg.vocab_size,
-            dtype=jnp.float32,
-            use_bias=False,
-            kernel_init=_dense_init("embed", "vocab"),
-            name="lm_head",
-        )(x)
+        if cfg.weight_dtype:
+            # quantized head: logits still f32 (the scale multiply IS the
+            # f32 promotion); quantized_matmuls deliberately leaves the
+            # head at full precision (accumulation/loss contract)
+            logits = WeightQuantDense(
+                (cfg.vocab_size,), in_axes=1,
+                bits=_WQ_BITS[cfg.weight_dtype],
+                dtype=jnp.float32, name="lm_head",
+            )(x)
+        else:
+            # the historical call, kept verbatim
+            logits = nn.Dense(
+                cfg.vocab_size,
+                dtype=jnp.float32,
+                use_bias=False,
+                kernel_init=_dense_init("embed", "vocab"),
+                name="lm_head",
+            )(x)
         return logits
 
 

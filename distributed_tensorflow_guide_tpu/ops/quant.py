@@ -1,0 +1,293 @@
+"""Quantization across the stack: weight-only decode, int8 training
+matmuls, and int8-compressed gradient collectives.
+
+After PR 6 halved the KV-cache read and PR 12 collapsed shared prefill,
+the byte models say the remaining order-of-magnitude levers are all
+quantization (ROADMAP open item 3): the **weights** dominate the HBM read
+of a decode step (``decode_hbm_bytes_per_step`` charges every
+non-embedding parameter once per token), and **gradients / outer deltas**
+dominate the ICI/DCN wire (``dp_allreduce_bytes`` / ``outer_sync_bytes``).
+This module holds the three primitives; the consumers thread them behind
+default-off knobs so every historical trace stays byte-identical:
+
+* **Weight-only int8/int4 decode** — :func:`quantize_channelwise` /
+  :func:`wq_matmul`, consumed by ``models/transformer.py`` behind
+  ``TransformerConfig.weight_dtype``. Per-OUTPUT-channel symmetric scales
+  (the ``quantize_kv`` contract: ``scale = where(amax > 0, amax/qmax, 1)``
+  so a zero column dequantizes to exact zero, never 0/0), and the dequant
+  is FUSED into the matmul: the int8 kernel is cast inside the
+  contraction and the f32 scale lands on the OUTPUT columns — the scale
+  is constant along the contracted axis, so it factors out exactly and no
+  dequantized kernel copy is ever materialized (the decode-attention
+  int8-KV discipline applied to the weights; pinned by a jaxpr walk in
+  tests/test_quant.py). int4 packs two nibbles per byte
+  (:func:`pack_int4`) for the ~8x params-read diet.
+
+* **AQT-style int8 training matmul** — :func:`int8_ste_dot`: f32 master
+  params stay the source of truth, per-TENSOR scales are re-derived
+  dynamically every step (nothing quantized is ever stored), the
+  contraction runs int8 x int8 -> int32 (the MXU-native mode), and the
+  backward is straight-through: gradients of the UNquantized matmul, so
+  the quantizer's staircase never zeroes the training signal. Behind
+  ``core/precision.py`` ``PRESETS["int8"]``.
+
+* **int8-compressed all-reduce** — :func:`int8_pmean`: the bucket/outer
+  transform for ``parallel/overlap.py`` and ``parallel/multislice.py``.
+  Overflow-safe by construction: one per-bucket amax is shared via a
+  scalar ``pmax`` (the tiny f32 side-channel), then every device
+  quantizes with ``n``-headroom — clip at ``127 // n`` — so the int8
+  ring SUM cannot wrap; dequant divides the shared scale back out. Wire
+  payload: 1 byte/elem instead of 4 (the ``compress="int8"`` closed-form
+  variants in benchmarks/common.py), plus 4 bytes of scale per bucket.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "QMAX",
+    "quantize_channelwise",
+    "dequantize_channelwise",
+    "pack_int4",
+    "unpack_int4",
+    "wq_matmul",
+    "quantize_params",
+    "WQ_PROJECTIONS",
+    "int8_ste_dot",
+    "int8_pmean",
+]
+
+#: Symmetric integer grids: int8 clips at +-127 (the quantize_kv
+#: convention — -128 stays unused so the grid is symmetric), int4 at +-7.
+QMAX = {8: 127, 4: 7}
+
+
+def _check_bits(bits: int) -> int:
+    if bits not in QMAX:
+        raise ValueError(f"bits must be one of {sorted(QMAX)}, got {bits}")
+    return QMAX[bits]
+
+
+def quantize_channelwise(w, bits: int = 8):
+    """Per-output-channel symmetric quantization of a 2-D ``(d_in, d_out)``
+    kernel: ``(int8 values, f32 scale (d_out,))``. Same contract as
+    ``ops.decode_attention.quantize_kv``: one scale per output column
+    (amax over the contracted d_in axis), an all-zero column maps to
+    scale 1 (not 0) so dequant is always exact-zero, and round-to-nearest
+    keeps the error per element <= scale/2."""
+    qmax = _check_bits(bits)
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=0)  # (d_out,)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    values = jnp.clip(jnp.round(wf / scale[None, :]), -qmax, qmax)
+    return values.astype(jnp.int8), scale
+
+
+def dequantize_channelwise(q, scale):
+    """The UNFUSED reference dequant — materializes the full f32 kernel
+    copy that :func:`wq_matmul` exists to avoid. Test oracle and the
+    positive control of the fused-dequant jaxpr pin; never on a serving
+    path."""
+    return q.astype(jnp.float32) * scale[None, :]
+
+
+def pack_int4(q):
+    """Pack int4 values (int8 storage, range [-7, 7]) two-per-byte along
+    axis 0: row ``2i`` rides the low nibble, row ``2i+1`` the high nibble
+    of packed row ``i``. uint8 storage so the nibble arithmetic never
+    touches implementation-defined signed narrowing. Requires an even
+    axis-0 length (every projection width in the judged configs is)."""
+    if q.shape[0] % 2:
+        raise ValueError(
+            f"pack_int4 needs an even leading axis, got {q.shape}")
+    u = q.astype(jnp.uint8) & 0xF  # two's-complement nibbles
+    return (u[1::2] << 4) | u[0::2]
+
+
+def unpack_int4(packed):
+    """Bitwise inverse of :func:`pack_int4`: ``(2n, ...)`` int8 values in
+    [-8, 7] from ``(n, ...)`` packed bytes (sign-extended nibbles)."""
+    lo = (packed & 0xF).astype(jnp.int8)
+    hi = ((packed >> 4) & 0xF).astype(jnp.int8)
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    inter = jnp.stack([lo, hi], axis=1)  # (n, 2, ...)
+    return inter.reshape((2 * packed.shape[0],) + packed.shape[1:])
+
+
+def wq_matmul(x, qkernel, scale, *, bits: int = 8, dtype=jnp.float32):
+    """``x @ dequant(qkernel)`` with the dequant FUSED into the matmul.
+
+    ``x`` is ``(..., d_in)`` at the activation dtype, ``qkernel`` the
+    stored int8 (or int4-packed uint8) ``(d_in[, /2], d_out)`` kernel,
+    ``scale`` the per-output-column f32 scales. The int cast rides the
+    contraction (XLA folds the convert into the matmul read — the HBM
+    bytes that cross the wire are the stored dtype's, which is what the
+    cost auditor charges) and the scale multiplies the OUTPUT columns:
+    scale is constant along the contracted axis, so
+    ``(x @ q) * s == x @ (q * s)`` exactly — the dequantized kernel copy
+    is never materialized."""
+    _check_bits(bits)
+    w = unpack_int4(qkernel) if bits == 4 else qkernel
+    y = lax.dot_general(
+        x, w.astype(dtype),
+        dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())))
+    return (y.astype(jnp.float32) * scale).astype(dtype)
+
+
+#: Projection submodule names quantize_params rewrites, mapped to how many
+#: LEADING kernel axes are contracted (flax DenseGeneral stores kernels as
+#: (in..., out...)): attention ``proj`` contracts (heads, head_dim).
+WQ_PROJECTIONS = {"qkv": 1, "proj": 2, "up": 1, "down": 1, "lm_head": 1}
+
+
+def _unbox(leaf):
+    # flax logical-partitioning boxes (nn.Partitioned) carry .unbox()
+    return leaf.unbox() if hasattr(leaf, "unbox") else leaf
+
+
+def quantize_params(params, *, bits: int = 8,
+                    projections: dict | None = None):
+    """The serving-side tree transform: an f32 ``Transformer`` param tree
+    re-expressed for ``TransformerConfig.weight_dtype``. Every projection
+    kernel (``{kernel}`` under a name in :data:`WQ_PROJECTIONS`) becomes
+    ``{qkernel, scale}`` — the exact layout ``WeightQuantDense`` declares,
+    so ``model.apply`` on the quantized config consumes this tree
+    directly. Biases, LayerNorms and the (gathered, never streamed)
+    embedding tables pass through untouched; the f32 oracle tree is left
+    unmodified (pure function)."""
+    _check_bits(bits)
+    projections = WQ_PROJECTIONS if projections is None else projections
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for name, child in node.items():
+            if (name in projections and isinstance(child, dict)
+                    and "kernel" in child):
+                n_in = projections[name]
+                kernel = jnp.asarray(_unbox(child["kernel"]))
+                in_shape = kernel.shape[:n_in]
+                d_in = 1
+                for d in in_shape:
+                    d_in *= int(d)
+                k2d = kernel.reshape(d_in, -1)
+                q, scale = quantize_channelwise(k2d, bits=bits)
+                if bits == 4:
+                    q = pack_int4(q)
+                rebuilt = {"qkernel": q, "scale": scale}
+                for extra, v in child.items():  # biases ride along
+                    if extra != "kernel":
+                        rebuilt[extra] = v
+                out[name] = rebuilt
+            else:
+                out[name] = walk(child)
+        return out
+
+    return walk(jax.tree.map(lambda x: x, params))  # dict-ified copy
+
+
+# --------------------------------------------------------------------------
+# AQT-style int8 training matmul (straight-through estimator)
+# --------------------------------------------------------------------------
+
+
+def _dynamic_quant(t):
+    """Per-TENSOR dynamic int8 quantization (training side): one scale for
+    the whole operand, re-derived from this step's values — nothing
+    quantized is ever stored, the f32 master stays the source of truth."""
+    amax = jnp.max(jnp.abs(t)).astype(jnp.float32)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(t.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+@jax.custom_vjp
+def int8_ste_dot(x, w):
+    """AQT-style quantized contraction: ``(..., d_in) x (d_in, d_out)`` in
+    int8 with int32 accumulation (the MXU-native mode), dequantized by the
+    product of the two per-tensor scales on the way out (f32). Backward is
+    straight-through: the gradients of the UNquantized matmul, so the
+    round/clip staircase (zero derivative almost everywhere) never kills
+    the training signal. Returns f32 — callers cast to their activation
+    dtype, keeping the dequant product in the accumulation dtype."""
+    qx, sx = _dynamic_quant(x)
+    qw, sw = _dynamic_quant(w)
+    acc = lax.dot_general(
+        qx, qw, dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * (sx * sw)
+
+
+def _int8_ste_fwd(x, w):
+    return int8_ste_dot(x, w), (x, w)
+
+
+def _int8_ste_bwd(res, g):
+    x, w = res
+    gf = g.astype(jnp.float32)
+    dx = jnp.einsum("...f,df->...d", gf,
+                    w.astype(jnp.float32)).astype(x.dtype)
+    dw = jnp.einsum("...d,...f->df", x.astype(jnp.float32),
+                    gf).astype(w.dtype)
+    return dx, dw
+
+
+int8_ste_dot.defvjp(_int8_ste_fwd, _int8_ste_bwd)
+
+
+# --------------------------------------------------------------------------
+# int8-compressed gradient all-reduce (the bucket/outer-delta transform)
+# --------------------------------------------------------------------------
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def int8_pmean(tree: Any, axis: str):
+    """``pmean(tree, axis)`` with the payload on the wire in int8.
+
+    One shared scale per call (the "bucket"): local amaxes are maxed over
+    the float leaves, shared across the axis with a scalar ``pmax`` (4
+    wire bytes — the f32 side-channel), then every device quantizes with
+    ``n``-headroom — the clip limit is ``127 // n`` — so the int8 ring
+    SUM can never wrap int8. ONE int8 ``psum`` carries all the leaves;
+    dequant multiplies the shared scale back and divides by ``n`` for the
+    mean. Per-element error is bounded by ``scale/2`` with
+    ``scale = amax_global / (127 // n)`` — coarser than the storage-side
+    per-channel grids on purpose: gradients tolerate it (the parity
+    tolerance tests/test_overlap.py pins) and the wire pays 1 byte/elem
+    instead of 4 (``dp_allreduce_bytes(..., compress="int8")``).
+    Non-float leaves (optax step counts) pass through untouched, the
+    ``_pmean_floats`` convention."""
+    import distributed_tensorflow_guide_tpu.collectives as cc
+
+    leaves, treedef = jax.tree.flatten(tree)
+    fidx = [i for i, leaf in enumerate(leaves) if _is_float(leaf)]
+    if not fidx:
+        return tree
+    n = cc.axis_size(axis)
+    headroom = max(1, 127 // n)
+    amax = functools.reduce(
+        jnp.maximum,
+        [jnp.max(jnp.abs(leaves[i].astype(jnp.float32))) for i in fidx])
+    amax = cc.pmax(amax, axis)  # shared scale: the tiny f32 side-channel
+    scale = jnp.where(amax > 0, amax / headroom, 1.0)
+    quantized = tuple(
+        jnp.clip(jnp.round(leaves[i].astype(jnp.float32) / scale),
+                 -headroom, headroom).astype(jnp.int8)
+        for i in fidx)
+    summed = cc.psum(quantized, axis)  # one int8 collective per bucket
+    out = list(leaves)
+    for i, s in zip(fidx, summed):
+        out[i] = (s.astype(jnp.float32) * (scale / n)).astype(
+            leaves[i].dtype)
+    return jax.tree.unflatten(treedef, out)

@@ -56,7 +56,8 @@ class DataParallel:
     """
 
     def __init__(self, mesh: Mesh, axis: str = "data", *,
-                 overlap="off", bucket_bytes: int | None = None):
+                 overlap="off", bucket_bytes: int | None = None,
+                 compress: str | None = None):
         from distributed_tensorflow_guide_tpu.parallel import (
             overlap as overlap_mod,
         )
@@ -66,6 +67,15 @@ class DataParallel:
         self.world = axis_sizes(mesh)[axis]
         self.overlap = overlap_mod.resolve_overlap(overlap)
         self.bucket_bytes = bucket_bytes
+        # int8-compressed gradient all-reduce (ops/quant.int8_pmean):
+        # rides the bucket seams, so it requires the bucketed backward —
+        # the mono pmean stays the bitwise-exact historical program.
+        self.compress = overlap_mod.resolve_compress(compress)
+        if self.compress and not self.overlap:
+            raise ValueError(
+                "compress='int8' rides the bucketed backward — it "
+                "requires overlap=True (the monolithic pmean path stays "
+                "bitwise-exact by contract)")
 
     # ---- data placement ----------------------------------------------------
     def shard_batch(self, batch: Any) -> Any:
@@ -228,7 +238,7 @@ class DataParallel:
         )
 
         return overlap_mod.bucketed_loss_fn(
-            loss_fn, self.axis, self.bucket_bytes)
+            loss_fn, self.axis, self.bucket_bytes, compress=self.compress)
 
     def make_train_step(self, loss_fn: LossFn, *, donate: bool = True,
                         accum_steps: int = 1, steps_per_call: int = 1,
@@ -388,7 +398,7 @@ def lint_contracts():
     )
     from distributed_tensorflow_guide_tpu.parallel import overlap
 
-    def build(overlap_on):
+    def build(overlap_on, compress=None):
         def _build():
             from distributed_tensorflow_guide_tpu.analysis.fixtures import (
                 tiny_mlp,
@@ -397,7 +407,8 @@ def lint_contracts():
             loss_fn, state, batch = tiny_mlp()
             mesh = build_mesh(MeshSpec(data=-1))
             dp = DataParallel(mesh, overlap=overlap_on,
-                              bucket_bytes=1 if overlap_on else None)
+                              bucket_bytes=1 if overlap_on else None,
+                              compress=compress)
             step = dp.make_train_step(loss_fn, donate=True)
             return step, (state, batch)
 
@@ -420,6 +431,25 @@ def lint_contracts():
         world = jax.device_count()
         return (common.dp_allreduce_bytes(grad_bytes, world)
                 + 2 * common.dp_allreduce_bytes(4, world))
+
+    def _int8_allreduce_expect():
+        # the same grad tree at 1 byte/elem on the wire (the int8 payload
+        # of the compressed buckets) + the 2 f32 scalar metric pmeans
+        import jax
+
+        common = closed_forms()
+        world = jax.device_count()
+        return (common.dp_allreduce_bytes(grad_bytes, world,
+                                          compress="int8")
+                + 2 * common.dp_allreduce_bytes(4, world))
+
+    def _scale_sidechannel_expect():
+        # one f32 amax scalar rides a ring pmax per bucket
+        import jax
+
+        common = closed_forms()
+        world = jax.device_count()
+        return n_buckets * common.dp_allreduce_bytes(4, world)
 
     def _flops_expect():
         # the 3x-forward MFU convention counts 6 forward-equivalent
@@ -473,4 +503,37 @@ def lint_contracts():
             cost=dataclasses.replace(dp_cost, max_peak_live_bytes=18432),
             notes=f"bucketed backward: {n_buckets} buckets -> "
                   f"{n_buckets} grad psums"),
+        ProgramContract(
+            name="dp_overlap_int8_round",
+            build=build(True, compress="int8"),
+            policy="f32",
+            # one int8 psum per gradient bucket + the 2 f32 metric pmeans,
+            # plus one scalar pmax per bucket — the shared-scale f32
+            # side-channel of the compressed wire format
+            collectives={"psum[data]": n_buckets + 2,
+                         "pmax[data]": n_buckets},
+            donation=DonationSpec(argnums=(0,)),
+            sources=sources,
+            cost=dataclasses.replace(
+                dp_cost,
+                pins=(
+                    CostPin("collective_bytes[psum[data]]",
+                            _int8_allreduce_expect,
+                            note="grad ring at 1 byte/elem "
+                                 "(compress='int8') + 2 scalar metric "
+                                 "pmeans at f32"),
+                    CostPin("collective_bytes[pmax[data]]",
+                            _scale_sidechannel_expect,
+                            note="one f32 amax scalar per bucket: the "
+                                 "shared-scale side-channel"),
+                    dp_cost.pins[1],  # same matmul flops: only the wire
+                                      # representation changed
+                ),
+                # measured 21212: the f32 bucket budget (18432) plus the
+                # transient int8 shadow buffers + f32 scales the quantize/
+                # dequant seam holds while the wire copy is in flight
+                max_peak_live_bytes=22528),
+            notes=f"int8-compressed bucketed backward: {n_buckets} "
+                  "buckets at a quarter of the grad bytes + "
+                  f"{n_buckets} scale pmaxes"),
     ]

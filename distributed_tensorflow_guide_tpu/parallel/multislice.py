@@ -195,7 +195,12 @@ class MultiSliceLocalSGD:
         inner_axis: str = "data",
         outer_axis: str = DCN_AXIS,
         outer: str = "on",
+        compress: str | None = None,
     ):
+        from distributed_tensorflow_guide_tpu.parallel.overlap import (
+            resolve_compress,
+        )
+
         sizes = axis_sizes(mesh)
         for ax in (inner_axis, outer_axis):
             if ax not in sizes:
@@ -207,6 +212,13 @@ class MultiSliceLocalSGD:
             raise ValueError(f"sync_period must be >= 1, got {sync_period}")
         if outer not in ("on", "off"):
             raise ValueError(f"outer must be 'on' or 'off', got {outer!r}")
+        # int8-compressed outer sync (ops/quant.int8_pmean): the delta and
+        # float opt-state cross DCN at 1 byte/elem with one shared-scale
+        # f32 pmax each — outer_sync_bytes(..., compress="int8") is the
+        # closed form. The round's OUTER delta is exactly the signal that
+        # tolerates coarse quantization (DiLoCo's premise: it is already
+        # an average of sync_period updates); inner ICI grads stay f32.
+        self.compress = resolve_compress(compress)
         self.mesh = mesh
         self.sync_period = sync_period
         self.outer_lr = float(outer_lr)
@@ -272,6 +284,16 @@ class MultiSliceLocalSGD:
 
     # ---- the compiled outer round -----------------------------------------
 
+    def _outer_pmean(self, tree: Any) -> Any:
+        """The outer-tier float pmean: int8 wire format when compressed,
+        the historical per-leaf f32 pmean otherwise (byte-identical
+        default trace)."""
+        if self.compress == "int8":
+            from distributed_tensorflow_guide_tpu.ops import quant
+
+            return quant.int8_pmean(tree, self.outer_axis)
+        return _pmean_floats(tree, self.outer_axis)
+
     def make_train_step(self, loss_fn: LossFn, *, donate: bool = True):
         mu = self.outer_momentum
 
@@ -298,7 +320,7 @@ class MultiSliceLocalSGD:
                 delta = jax.tree.map(jnp.subtract, anchor, params)
                 # the ONLY collectives on the DCN tier: one param-delta
                 # pmean + the float opt-state pmean, per round
-                delta = _pmean_floats(delta, self.outer_axis)
+                delta = self._outer_pmean(delta)
                 momentum = jax.tree.map(
                     lambda m, d: mu * m + d if _is_float(d) else m,
                     tt.outer_momentum,
@@ -322,7 +344,7 @@ class MultiSliceLocalSGD:
                     anchor,
                     update,
                 )
-                opt_state = _pmean_floats(opt_state, self.outer_axis)
+                opt_state = self._outer_pmean(opt_state)
             new_inner = state.replace(
                 step=state.step + self.sync_period,
                 params=params,

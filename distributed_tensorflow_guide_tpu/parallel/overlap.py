@@ -63,6 +63,7 @@ import distributed_tensorflow_guide_tpu.collectives as cc
 __all__ = [
     "resolve_overlap",
     "resolve_prefetch",
+    "resolve_compress",
     "bucket_assignment",
     "bucket_sync",
     "pmean_buckets",
@@ -115,6 +116,23 @@ def resolve_prefetch(setting, *, platform: str | None = None) -> bool:
     return _resolve_tpu_auto(setting, "fsdp prefetch", platform)
 
 
+def resolve_compress(setting) -> str | None:
+    """Normalize the gradient-compression knob: ``None``/"off"/"none" ->
+    None (full-precision wire, the historical path), "int8" -> "int8".
+    Deliberately NOT platform-auto: compression changes numerics (bounded
+    but nonzero quantization error), so it is only ever an explicit
+    opt-in — never a backend-resolved default."""
+    if setting is None:
+        return None
+    s = str(setting).lower()
+    if s in ("off", "none", ""):
+        return None
+    if s == "int8":
+        return "int8"
+    raise ValueError(
+        f"compress must be None/'off' or 'int8', got {setting!r}")
+
+
 # --------------------------------------------------------------------------
 # bucketed DP all-reduce
 # --------------------------------------------------------------------------
@@ -152,45 +170,60 @@ def bucket_assignment(leaves: Sequence[Any],
     return groups
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def bucket_sync(leaves: tuple, axis: str):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def bucket_sync(leaves: tuple, axis: str, compress: str | None = None):
     """The DDP bucket boundary: identity forward, pmean backward.
 
     Applied to one bucket's parameter leaves at the loss function's input,
     so the bucket's gradient all-reduce appears in the backward exactly
     where its cotangents are produced — mid-backward, overlappable —
     instead of after the full gradient tree.
+
+    ``compress="int8"`` swaps the backward collective for the
+    int8-compressed variant (ops/quant.int8_pmean): one shared scale per
+    bucket rides a scalar ``pmax`` side-channel and the payload crosses
+    the wire at 1 byte/elem — ``dp_allreduce_bytes(..., compress="int8")``
+    is the closed form, ``dp_overlap_int8_round`` the audited program.
+    The default keeps the historical bitwise-exact pmean.
     """
     return leaves
 
 
-def _bucket_sync_fwd(leaves, axis):
+def _bucket_sync_fwd(leaves, axis, compress):
     return leaves, None
 
 
-def _bucket_sync_bwd(axis, _, cts):
+def _bucket_sync_bwd(axis, compress, _, cts):
     # one fused collective per bucket; recorded in the ambient trace_comm
     # like every collective the framework issues
+    if compress == "int8":
+        from distributed_tensorflow_guide_tpu.ops import quant
+
+        return (quant.int8_pmean(cts, axis),)
     return (cc.pmean(cts, axis),)
 
 
 bucket_sync.defvjp(_bucket_sync_fwd, _bucket_sync_bwd)
 
 
-def pmean_buckets(tree: Any, axis: str, bucket_bytes: int) -> Any:
+def pmean_buckets(tree: Any, axis: str, bucket_bytes: int,
+                  compress: str | None = None) -> Any:
     """Wrap a parameter tree in per-bucket sync markers: values unchanged,
-    gradients come out pmean-ed over ``axis`` per bucket."""
+    gradients come out pmean-ed over ``axis`` per bucket (int8 on the wire
+    when ``compress="int8"``)."""
     leaves, treedef = jax.tree.flatten(tree)
     out = list(leaves)
     for group in bucket_assignment(leaves, bucket_bytes):
-        synced = bucket_sync(tuple(leaves[i] for i in group), axis)
+        synced = bucket_sync(tuple(leaves[i] for i in group), axis,
+                             compress)
         for i, v in zip(group, synced):
             out[i] = v
     return jax.tree.unflatten(treedef, out)
 
 
 def bucketed_loss_fn(loss_fn: Callable, axis: str,
-                     bucket_bytes: int | None = None) -> Callable:
+                     bucket_bytes: int | None = None,
+                     compress: str | None = None) -> Callable:
     """Wrap ``loss_fn(params, *rest)`` so ``jax.grad`` of the result yields
     gradients that are ALREADY pmean-ed over ``axis``, one bucket at a time
     (call sites must not pmean again — that would double-reduce).
@@ -198,8 +231,11 @@ def bucketed_loss_fn(loss_fn: Callable, axis: str,
     ``bucket_bytes=None`` resolves through the autotune table at trace time
     (shapes are static): the tuned entry for (param bytes, world) when one
     exists, else the tested default. On CPU the table is never read — the
-    defaults-only hermeticity contract.
+    defaults-only hermeticity contract. A compressed wire tunes under its
+    OWN key (dtype=int8 — bigger buckets amortize differently at a quarter
+    of the bytes), same defaults-only posture.
     """
+    compress = resolve_compress(compress)
 
     def wrapped(params, *rest):
         bb = bucket_bytes
@@ -210,9 +246,10 @@ def bucketed_loss_fn(loss_fn: Callable, axis: str,
             bb = autotune.bucket_bytes_for(
                 param_bytes=sum(_leaf_bytes(l) for l in p_leaves),
                 world=cc.axis_size(axis),
-                dtype=p_leaves[0].dtype if p_leaves else np.float32,
+                dtype=(np.int8 if compress == "int8"
+                       else p_leaves[0].dtype if p_leaves else np.float32),
             )
-        return loss_fn(pmean_buckets(params, axis, bb), *rest)
+        return loss_fn(pmean_buckets(params, axis, bb, compress), *rest)
 
     return wrapped
 

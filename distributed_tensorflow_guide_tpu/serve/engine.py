@@ -795,6 +795,7 @@ def lint_contracts():
     anyone reintroduces dense (slots, heads, chunk, max_len) attention
     scores into the compiled serve path."""
     from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        CostPin,
         CostSpec,
         DonationSpec,
         ProgramContract,
@@ -818,7 +819,9 @@ def lint_contracts():
             cfg = dataclasses.replace(
                 tiny_lm_cfg(vocab_size=32, max_len=MAXLEN),
                 decode_impl="pallas",
-                **({"lora_rank": 2, "lora_adapters": 2} if lora else {}))
+                **({"lora_rank": 2, "lora_adapters": 2} if lora else {}),
+                **({"weight_dtype": "int8"} if kind == "decode_wq8"
+                   else {}))
             fns = build_step_fns(cfg, slots=S, num_blocks=NB,
                                  block_size=BS, prefill_chunk=CH)
             variables = jax.eval_shape(
@@ -863,16 +866,61 @@ def lint_contracts():
                  "distributed_tensorflow_guide_tpu.serve.paged_cache",
                  "distributed_tensorflow_guide_tpu.models.transformer"),
     )
+
+    # every quantized kernel elem in the fixture model: per layer
+    # qkv 768 + proj 256 + up 512 + down 512 = 2048, x 2 layers, plus
+    # lm_head 16*32 = 512 -> 4608 elems; int8 storage saves 3 bytes on
+    # each one per decode step (the narrow-origin matmul read)
+    WQ8_SAVED_BYTES = 3 * 4608
+
+    def _wq8_hbm_read_expect():
+        """The f32 sibling's derived read bytes minus the weight-only
+        savings — pinning the wq8 program AGAINST its own f32 trace, so
+        the pin can only pass if quantization removed exactly the kernel
+        bytes and changed nothing else about the program's traffic."""
+        import jax.numpy as _jnp
+
+        from distributed_tensorflow_guide_tpu.analysis import (
+            cost as cost_mod,
+            rules as rules_mod,
+        )
+
+        fn, args = _build("decode")()
+        jaxpr = jax.make_jaxpr(fn)(*args)
+        traced = rules_mod.TracedProgram(
+            name="serve_decode_step", jaxpr=jaxpr,
+            arg_leaf_avals=[
+                [jax.ShapeDtypeStruct(_jnp.shape(x), _jnp.result_type(x))
+                 for x in jax.tree.leaves(a)] for a in args])
+        f32_vec = cost_mod.program_cost(traced, sibling)
+        return f32_vec.hbm_bytes_read - WQ8_SAVED_BYTES
+
+    sibling = ProgramContract(
+        name="serve_decode_step",
+        build=_build("decode"),
+        # one 96KiB ceiling across the serve programs: the aliased
+        # pool keeps all three in the 75-91KiB band, and a dead pool
+        # donation would blow straight through it
+        cost=CostSpec(max_peak_live_bytes=98304),
+        notes="fixed-slot paged decode: pool aliased in place, no "
+              "full-max_len f32 score tensor",
+        **common)
     return [
+        sibling,
         ProgramContract(
-            name="serve_decode_step",
-            build=_build("decode"),
-            # one 96KiB ceiling across the serve programs: the aliased
-            # pool keeps all three in the 75-91KiB band, and a dead pool
-            # donation would blow straight through it
-            cost=CostSpec(max_peak_live_bytes=98304),
-            notes="fixed-slot paged decode: pool aliased in place, no "
-                  "full-max_len f32 score tensor",
+            name="serve_decode_step_wq8",
+            build=_build("decode_wq8"),
+            quantized_matmuls=True,
+            cost=CostSpec(
+                pins=(CostPin(
+                    "hbm_bytes_read", _wq8_hbm_read_expect,
+                    note="f32 decode read bytes minus 3 B x 4608 "
+                         "quantized kernel elems"),),
+                max_peak_live_bytes=98304),
+            notes="weight-only int8 decode: same program as "
+                  "serve_decode_step with every projection kernel "
+                  "stored int8 + f32 column scales, dequant fused into "
+                  "the matmul (no f32 weight copy under the f32 cap)",
             **common),
         ProgramContract(
             name="serve_prefill_chunk_step",
