@@ -46,6 +46,22 @@ from kill until token progress catches back up to the kill point),
 rate), ``shed_rate`` and the ``zero_dropped_streams`` verdict (every
 workload request reaches a terminal state — completed, cancelled,
 expired or shed — none silently vanish, even through the kill).
+
+``--longtail-mix N`` adds the cache-hierarchy phase (PR 16): N
+multi-turn interactive sessions — each turn's prompt is the previous
+turn's prompt plus the engine's own greedy reply plus a fresh suffix —
+with cohort-scale idle think-time between turns, driven at the top
+calibrated rate through hierarchy ON (``host_blocks`` > 0) and OFF
+engines in one invocation. The sessions' combined context exceeds the
+pool, so the OFF side destroys cold prefixes (re-prefill on the next
+turn) while the ON side demotes them to host RAM and swaps them back
+through the prefix-claim path. Reported: goodput A/B, spill counters,
+modeled-vs-traced swap bytes (h2d equality is exact; d2h may dedup
+COW-shared blocks) and ``spill_streams_bitwise_identical`` — the
+hierarchy moves COST, never CONTENT. ``--persist-cache`` adds the
+warm-restart leg: the warm cache (spilled blocks + trie) snapshots to
+disk, restores into a fresh engine, and every session's final turn
+replays with zero cached-prefix re-prefill.
 """
 
 import argparse
@@ -73,8 +89,11 @@ def main() -> None:
     ap.add_argument("--slots", type=int, default=4,
                     help="decode batch width (resident requests)")
     ap.add_argument("--block-size", type=int, default=8)
-    ap.add_argument("--num-blocks", type=int, default=33,
-                    help="pool size incl. the trash block")
+    ap.add_argument("--num-blocks", type=int, default=65,
+                    help="pool size incl. the trash block; the default "
+                         "fits the full-size length mix's longest draw "
+                         "(256 prompt + 192 decode = 56 blocks) — the "
+                         "old 33 made the admission gate reject it")
     ap.add_argument("--prefill-chunk", type=int, default=32,
                     help="prefill chunk width; the default covers the "
                          "whole length mix in one chunk (chunking OFF), "
@@ -110,6 +129,26 @@ def main() -> None:
                          "one invocation (TTFT A/B + tokens saved), "
                          "plus a tenant-0 burst under a slots quota "
                          "(fair-share bound)")
+    ap.add_argument("--host-blocks", type=int, default=0,
+                    help="host-RAM spill tier capacity in KV blocks for "
+                         "every engine in the run (0 = hierarchy off, "
+                         "the pool-only legacy paths); the longtail "
+                         "phase's ON side defaults to 4x --num-blocks "
+                         "when this is 0")
+    ap.add_argument("--longtail-mix", type=int, default=0, metavar="N",
+                    help="add the cache-hierarchy phase (PR 16): N "
+                         "multi-turn interactive sessions with long "
+                         "idle think-time gaps drive the engine at the "
+                         "top calibrated rate, hierarchy ON vs OFF in "
+                         "one invocation — goodput A/B, spill counters, "
+                         "modeled-vs-traced swap bytes and the bitwise "
+                         "stream cross-check")
+    ap.add_argument("--persist-cache", action="store_true",
+                    help="with --longtail-mix: snapshot the warm cache "
+                         "(spilled blocks + trie) at the end of the ON "
+                         "run, restore it into a fresh engine and "
+                         "replay every session's final turn — pins "
+                         "zero cached-prefix re-prefill")
     ap.add_argument("--lora-rank", type=int, default=0,
                     help="serve the continuous side multi-LoRA: each "
                          "request decodes under adapter rid %% 4 (0 = "
@@ -227,7 +266,10 @@ def main() -> None:
                       num_blocks=args.num_blocks,
                       block_size=args.block_size,
                       prefill_chunk=args.prefill_chunk,
-                      temperature=0.0, adapters=bank, recorder=rec)
+                      temperature=0.0, adapters=bank, recorder=rec,
+                      host_blocks=args.host_blocks)
+    if args.persist_cache and not args.longtail_mix:
+        raise SystemExit("--persist-cache requires --longtail-mix")
 
     def drive(workload, e=None):
         """Virtual clock: launches charged their measured wall time,
@@ -437,7 +479,8 @@ def main() -> None:
                 chaos=(FaultSchedule.random_serve(
                     args.seed + 17, max_position=60) if storm else None),
                 burst_factory=burst_factory,
-                snapshot_dir=snap_dir)
+                snapshot_dir=snap_dir,
+                host_blocks=args.host_blocks)
 
         def mkreq(rid, arr, toks, M):
             return Request(rid=rid, prompt=toks, max_new_tokens=M,
@@ -625,7 +668,8 @@ def main() -> None:
                 serve_cfg, params, slots=args.slots,
                 num_blocks=args.num_blocks, block_size=args.block_size,
                 prefill_chunk=args.prefill_chunk, temperature=0.0,
-                adapters=bank, prefix_cache=on, tenant_quotas=quotas)
+                adapters=bank, prefix_cache=on, tenant_quotas=quotas,
+                host_blocks=args.host_blocks)
 
         def ttft_p50_of(e, wl):
             ev, _ = drive(wl, e)
@@ -704,6 +748,230 @@ def main() -> None:
                 for t, c in fair_health["tenants"].items()},
         }
 
+    # ---- cache-hierarchy longtail phase (PR 16) --------------------------
+    longtail_extras = {}
+    if args.longtail_mix:
+        import math as _math
+        import tempfile
+
+        from benchmarks.common import spill_bytes_per_swap, spill_extras
+
+        N = args.longtail_mix
+        TURNS = 4
+        reply = int(min(mnews))
+        sfx_len = args.block_size
+        P0 = args.block_size
+        if P0 + (TURNS - 1) * (reply + sfx_len) + reply > cfg.max_len:
+            raise SystemExit("--longtail-mix: max_len too small for "
+                             f"{TURNS} turns of {reply} tokens")
+        # the ON side's host tier: generous by default — the point of
+        # the A/B is residency, not host-capacity tuning
+        HB = args.host_blocks if args.host_blocks else 4 * args.num_blocks
+        g = _math.lcm(args.block_size, args.prefill_chunk)
+        snap_dir = (tempfile.mkdtemp(prefix="bench_serve_cache_")
+                    if args.persist_cache else None)
+        rate = rates[top]
+
+        def longtail_engine(host_blocks, persist=False):
+            return ServeEngine(
+                serve_cfg, params, slots=args.slots,
+                num_blocks=args.num_blocks, block_size=args.block_size,
+                prefill_chunk=args.prefill_chunk, temperature=0.0,
+                adapters=bank, prefix_cache=True,
+                host_blocks=host_blocks,
+                snapshot_dir=snap_dir if persist else None,
+                persist_cache=persist)
+
+        def draw_sessions():
+            """The deterministic workload skeleton: initial prompts,
+            per-turn fresh suffixes, arrival times and think-time gaps
+            are all drawn up front from one seed, so the ON and OFF
+            engines see byte-identical session traces (the replies the
+            sessions feed back are greedy, hence identical too — that
+            equality IS the bitwise cross-check)."""
+            rng = np.random.RandomState(args.seed * 52711 + 50)
+            prompts0 = [rng.randint(0, cfg.vocab_size, P0).astype(np.int32)
+                        for _ in range(N)]
+            sfxs = [[rng.randint(0, cfg.vocab_size,
+                                 sfx_len).astype(np.int32)
+                     for _ in range(TURNS - 1)] for _ in range(N)]
+            arr0, now0 = [], 0.0
+            for _ in range(N):
+                now0 += rng.exponential(1.0 / rate)
+                arr0.append(now0)
+            # long idle gaps: cohort-scale think time between turns —
+            # sessions go COLD between turns, so their context blocks
+            # sit in the trie under pool pressure (N sessions' contexts
+            # exceed the pool), which is exactly what the hierarchy
+            # demotes instead of destroying
+            think = [[rng.exponential(2.0 * N / rate)
+                      for _ in range(TURNS - 1)] for _ in range(N)]
+            return prompts0, sfxs, arr0, think
+
+        def drive_longtail(e, tag):
+            """Closed-loop multi-turn driver on the virtual clock: turn
+            k+1's prompt is turn k's prompt + the engine's own emitted
+            reply + a fresh suffix; a finished session turn schedules
+            its next arrival one think-gap later."""
+            prompts0, sfxs, arr0, think = draw_sessions()
+            ctx = [p.copy() for p in prompts0]
+            turn, nxt, act = [0] * N, list(arr0), [None] * N
+            arrmap, streams, events, now = {}, {}, [], 0.0
+            busy = 0.0
+            while True:
+                for i in range(N):
+                    if act[i] is None and turn[i] < TURNS \
+                            and nxt[i] <= now:
+                        rid = tag * 100000 + i * 100 + turn[i]
+                        arrmap[rid] = nxt[i]
+                        e.submit(Request(
+                            rid=rid, prompt=ctx[i].copy(),
+                            max_new_tokens=reply,
+                            rng=jax.random.PRNGKey(rid % (1 << 20)),
+                            arrival=nxt[i]))
+                        act[i] = rid
+                waiting = [nxt[i] for i in range(N)
+                           if act[i] is None and turn[i] < TURNS]
+                if not (e.sched.has_queued or e.sched.has_resident) \
+                        and not waiting:
+                    break
+                t0 = time.perf_counter()
+                evs, kind = e.step(now)
+                dt = time.perf_counter() - t0
+                if kind == "idle":
+                    nq = e.sched.next_arrival()
+                    cand = waiting + ([nq] if nq is not None else [])
+                    if not cand:
+                        break
+                    now = max(now, min(cand))
+                    continue
+                now += dt
+                busy += dt
+                events.extend(
+                    dataclasses.replace(ev, time=now) for ev in evs)
+                for i in range(N):
+                    rid = act[i]
+                    if rid is not None and rid in e.sched.finished:
+                        toks = np.asarray(e.sched.emitted.get(rid, []),
+                                          np.int32)
+                        streams[rid] = toks
+                        act[i] = None
+                        turn[i] += 1
+                        if turn[i] < TURNS:
+                            ctx[i] = np.concatenate(
+                                [ctx[i], toks, sfxs[i][turn[i] - 1]])
+                            nxt[i] = now + think[i][turn[i] - 1]
+            wl = [(rid, a, None, reply) for rid, a in arrmap.items()]
+            lat = latencies(events, wl)
+            # goodput over ENGINE-BUSY seconds, not wall span: the wall
+            # span is dominated by the (identical-by-construction) idle
+            # think gaps, which would average the A/B toward 1.0; per
+            # busy second is where saved prefill work is visible
+            good_toks = sum(n for ttft, tpot, n, _ in lat
+                            if ttft <= slo_ttft and tpot <= slo_tpot)
+            good = good_toks / busy if busy > 0 else 0.0
+            # TTFT of the turns that can hit the cache (turn >= 1)
+            later = [first - arrmap[x.rid] for x in events
+                     if x.rid in arrmap and x.rid % 100 >= 1
+                     and x.first and x.status == "ok" and x.token >= 0
+                     for first in (x.time,)]
+            ttft_later = float(np.median(later)) if later else 0.0
+            return streams, good, ctx, ttft_later
+
+        e_on = longtail_engine(HB, persist=args.persist_cache)
+        st_on, good_lt_on, final_prompts, ttft_lt_on = \
+            drive_longtail(e_on, tag=50)
+        h_on_lt = e_on.health()
+        steps_on_lt = dict(e_on.steps)
+        e_on.sched.check_leaks()
+
+        e_off = longtail_engine(0)
+        st_off, good_lt_off, _, ttft_lt_off = drive_longtail(e_off, tag=50)
+        h_off_lt = e_off.health()
+        steps_off_lt = dict(e_off.steps)
+        e_off.close()
+
+        bitwise = (set(st_on) == set(st_off) and all(
+            np.array_equal(st_on[r], st_off[r]) for r in st_on))
+
+        # modeled-vs-traced swap bytes: the h2d side copies every block
+        # it counts (the d2h side legitimately dedups COW-shared blocks
+        # against live host copies, so its bytes are <= blocks x model)
+        hd = serve_cfg.d_model // serve_cfg.num_heads
+        per_block_model = spill_bytes_per_swap(
+            serve_cfg.num_layers, serve_cfg.num_heads, args.block_size,
+            hd, serve_cfg.kv_dtype,
+            activation_dtype_bytes=np.dtype(serve_cfg.dtype).itemsize)
+        n_in = h_on_lt["spill_in_blocks"]
+        traced_per_block = (h_on_lt["spill_h2d_bytes"] / n_in
+                            if n_in else 0.0)
+        longtail_extras = {
+            "longtail_sessions": N,
+            "longtail_turns": TURNS,
+            "longtail_host_blocks": HB,
+            "longtail_goodput_on": round(good_lt_on, 2),
+            "longtail_goodput_off": round(good_lt_off, 2),
+            "longtail_goodput_gain": round(
+                good_lt_on / max(good_lt_off, 1e-9), 3),
+            "longtail_later_turn_ttft_p50_on": round(ttft_lt_on, 4),
+            "longtail_later_turn_ttft_p50_off": round(ttft_lt_off, 4),
+            "longtail_prefill_steps_on": steps_on_lt.get("prefill", 0),
+            "longtail_prefill_steps_off": steps_off_lt.get("prefill", 0),
+            "spill_streams_bitwise_identical": bitwise,
+            "spill_out_blocks": h_on_lt["spill_out_blocks"],
+            "spill_in_blocks": n_in,
+            "spill_prefetched_blocks": h_on_lt["spill_prefetched_blocks"],
+            "spill_resumes": h_on_lt["spill_resumes"],
+            "swapin_tokens_saved": h_on_lt["swapin_tokens_saved"],
+            "prefix_evictions_on": h_on_lt["prefix_evictions"],
+            "prefix_evictions_off": h_off_lt["prefix_evictions"],
+            "spill_bytes_model_per_block": per_block_model,
+            "spill_bytes_traced_per_block": round(traced_per_block, 1),
+            "spill_bytes_model_match": (
+                traced_per_block == per_block_model if n_in else None),
+        }
+        longtail_extras.update(spill_extras(
+            h_on_lt["spill_d2h_bytes"], h_on_lt["spill_h2d_bytes"]))
+
+        # warm-restart leg: persist the warm cache, restore into a
+        # fresh engine, replay every session's FINAL turn — the whole
+        # cached context must come back through the prefix-claim path
+        # (swap-in), never through re-prefill
+        if args.persist_cache:
+            e_on.save_snapshot()
+            e_on.close()
+            P_last = len(final_prompts[0])
+            expected_saved = N * ((P_last - 1) // g * g)
+            e_warm = longtail_engine(HB, persist=True)
+            restored = e_warm.restore_latest_snapshot()
+            base_saved = e_warm.sched.prefill_tokens_saved
+            gap = 100.0 * N / rate  # sequential replay: no pool races
+            replay = [(51 * 100000 + i, (i + 1) * gap,
+                       final_prompts[i], reply) for i in range(N)]
+            drive(replay, e_warm)
+            warm_saved = e_warm.sched.prefill_tokens_saved - base_saved
+            warm_bitwise = all(np.array_equal(
+                np.asarray(e_warm.sched.emitted[51 * 100000 + i],
+                           np.int32),
+                st_on[50 * 100000 + i * 100 + (TURNS - 1)])
+                for i in range(N))
+            h_warm = e_warm.health()
+            longtail_extras.update({
+                "warm_restored_step": restored,
+                "warm_restored_prefix_nodes": h_warm["prefix_nodes"],
+                "warm_prefill_tokens_saved": warm_saved,
+                "warm_expected_tokens_saved": expected_saved,
+                "warm_zero_cold_prefix_refill":
+                    warm_saved == expected_saved,
+                "warm_replay_bitwise_identical": warm_bitwise,
+                "warm_prefill_steps": dict(e_warm.steps).get(
+                    "prefill", 0),
+                "warm_spill_in_blocks": h_warm["spill_in_blocks"],
+            })
+            e_warm.close()
+        else:
+            e_on.close()
+
     # ---- the JSON line ---------------------------------------------------
     side = cont_good if args.mode == "continuous" else static_good
     other = static_good if args.mode == "continuous" else cont_good
@@ -718,6 +986,7 @@ def main() -> None:
         "decode_impl": cfg.resolve_decode_impl(),
         "prefill_chunk": args.prefill_chunk,
         "slots": args.slots,
+        "host_blocks": args.host_blocks,
         "offered_req_per_s": [round(r, 3) for r in rates],
         "goodput_per_rate": [round(g, 2) for g in cont_good],
         "static_goodput_per_rate": [round(g, 2) for g in static_good],
@@ -741,6 +1010,7 @@ def main() -> None:
     extras.update(trace_extras)
     extras.update(chaos_extras)
     extras.update(prefix_extras)
+    extras.update(longtail_extras)
     report("serve_goodput", side[top], "tokens/sec",
            baseline=other[top] if other[top] > 0 else None,
            **extras)

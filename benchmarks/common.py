@@ -320,6 +320,34 @@ def device_dcn_peak() -> float | None:
     return _device_peak(_TPU_DCN_PEAK)
 
 
+# Per-chip host<->device bandwidth (bytes/s, one direction) — the tier the
+# KV spill hierarchy (serve/scheduler.py) moves blocks across: PCIe Gen3
+# x16 class (~16 GB/s) for the v2-v4 generations, Gen4/Gen5 class for
+# v5/v6 per the public host-attach materials, divided by the chips sharing
+# the host's links where the spec says so. Same device_kind substring
+# keying as the FLOP/HBM/ICI/DCN tables. Sits BETWEEN HBM and DCN in the
+# hierarchy (~50-100x slower than HBM, ~2x faster than DCN) — that gap is
+# why demotion to host RAM beats re-prefill (compute-priced) but swap-in
+# latency still bounds goodput, not correctness (docs/serving.md). Like
+# every table here this is the ROOFLINE denominator of record pending an
+# on-deployment capture.
+_TPU_PCIE_PEAK: dict[str, float] = {
+    "v5 lite": 32e9, "v5litepod": 32e9, "v5e": 32e9,
+    "v5p": 32e9,
+    "v6 lite": 32e9, "v6e": 32e9,
+    "v4": 16e9,
+    "v3": 16e9,
+    "v2": 16e9,
+}
+
+
+def device_pcie_peak() -> float | None:
+    """Per-chip host<->device bandwidth (bytes/s) of the attached
+    accelerator, or None off-TPU — same contract as
+    :func:`device_peak_flops`."""
+    return _device_peak(_TPU_PCIE_PEAK)
+
+
 # --- closed-form per-device collective traffic (the comm_bytes_model) -----
 #
 # Ring-algorithm accounting, per device, per step: what bench_comm_overlap
@@ -493,6 +521,72 @@ def dcn_extras(comm_bytes: float, comm_secs: float | None = None,
                 achieved / (assumed_gbytes_per_s * 1e9), 4)
     if peak is None and assumed_gbytes_per_s:
         out["dcn_peak_gb_per_s_assumed"] = assumed_gbytes_per_s
+    return out
+
+
+def spill_block_bytes_terms(num_layers: int, num_heads: int,
+                            block_size: int, head_dim: int,
+                            kv_dtype: str | None = None, *,
+                            activation_dtype_bytes: int = 2) -> dict:
+    """Per-KV-block host<->device payload bytes, split into terms.
+
+    One demotion (d2h) or swap-in (h2d) of a paged-cache block moves, for
+    each of the ``num_layers`` layers, a K row and a V row of shape
+    ``[num_heads, block_size, head_dim]`` — at the activation dtype
+    (``cfg.dtype``, bf16 default, hence ``activation_dtype_bytes=2``)
+    when ``kv_dtype`` is None, int8 payload plus the per-(head, head_dim)
+    f32 scale rows when ``kv_dtype == "int8"`` (the quantized cache
+    stores one f32 scale vector per block, amortized over its
+    ``block_size`` positions, so int8 spills just over half the bf16
+    bytes, not exactly half). These terms are the EXACT nbytes of the
+    leaf rows the engine copies (engine ``_cache_d2h``) — the
+    reconciliation against the traced ``spill_d2h_bytes`` counter is
+    equality, not a bound."""
+    if kv_dtype not in (None, "int8"):
+        raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
+    elems = 2 * num_layers * num_heads * block_size * head_dim  # k and v
+    if kv_dtype is None:
+        return {"kv_payload_bytes": float(activation_dtype_bytes) * elems}
+    return {"kv_payload_bytes": 1.0 * elems,
+            "kv_scale_bytes": 4.0 * 2 * num_layers * num_heads * head_dim}
+
+
+def spill_bytes_per_swap(num_layers: int, num_heads: int, block_size: int,
+                         head_dim: int, kv_dtype: str | None = None, *,
+                         activation_dtype_bytes: int = 2) -> float:
+    """Headline total of :func:`spill_block_bytes_terms` — the modeled
+    bytes one block moves per demotion or swap-in."""
+    return sum(spill_block_bytes_terms(
+        num_layers, num_heads, block_size, head_dim, kv_dtype,
+        activation_dtype_bytes=activation_dtype_bytes).values())
+
+
+def spill_extras(d2h_bytes: float, h2d_bytes: float,
+                 swap_secs: float | None = None,
+                 assumed_gbytes_per_s: float | None = None) -> dict:
+    """Extra report() keys for spill-tier-honest benches, mirroring
+    :func:`dcn_extras`: the traced host<->device swap traffic both ways,
+    and — when the caller measured the swap time — the achieved wire rate
+    plus the fraction of the attached part's PCIe peak (real hardware
+    only). ``assumed_gbytes_per_s`` substitutes an assumed peak off-TPU so
+    CPU runs can still emit a MODELED fraction; the key is then suffixed
+    ``_model`` and the assumption echoed, so it can never be read as a
+    capture."""
+    total = float(d2h_bytes) + float(h2d_bytes)
+    out: dict = {"spill_d2h_bytes": round(float(d2h_bytes), 1),
+                 "spill_h2d_bytes": round(float(h2d_bytes), 1),
+                 "spill_gb": round(total / 1e9, 4)}
+    peak = device_pcie_peak()
+    if swap_secs is not None and swap_secs > 0 and total > 0:
+        achieved = total / swap_secs
+        out["pcie_gb_per_s"] = round(achieved / 1e9, 3)
+        if peak:
+            out["pcie_roofline_frac"] = round(achieved / peak, 4)
+        elif assumed_gbytes_per_s:
+            out["pcie_roofline_frac_model"] = round(
+                achieved / (assumed_gbytes_per_s * 1e9), 4)
+    if peak is None and assumed_gbytes_per_s:
+        out["pcie_peak_gb_per_s_assumed"] = assumed_gbytes_per_s
     return out
 
 

@@ -172,34 +172,41 @@ BATTERY: list[tuple[str, list[str], int]] = [
     ("serve_continuity",
      ["benchmarks/bench_serving.py", "--mode", "static",
       "--prefill-chunk", "32", "--kv-dtype", "model",
-      "--decode-impl", "dense", "--weight-dtype", "model"], 1800),
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0"], 1800),
     ("serve_paged",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
-      "--decode-impl", "dense", "--weight-dtype", "model"], 1800),
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0"], 1800),
     ("serve_chunked_prefill",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "8", "--kv-dtype", "model",
-      "--decode-impl", "dense", "--weight-dtype", "model"], 1800),
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0"], 1800),
     ("serve_kv_int8",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "int8",
-      "--decode-impl", "dense", "--weight-dtype", "model"], 1800),
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0"], 1800),
     ("serve_pallas",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
-      "--decode-impl", "pallas", "--weight-dtype", "model"], 1800),
+      "--decode-impl", "pallas", "--weight-dtype", "model",
+      "--host-blocks", "0"], 1800),
     # serving under fire (PR 11): one knob each — serve_paged + the
     # chaos storm, then + the mid-run kill/snapshot-restore leg
     ("serve_chaos",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0",
       "--chaos"], 1800),
     ("serve_snapshot_restore",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0",
       "--chaos", "--snapshot-restore"], 1800),
     # prefix sharing + tenancy (PR 12): one knob each — chunked prefill
     # + the prefix-mix phase (prefix cache ON vs OFF in one run), the
@@ -209,17 +216,35 @@ BATTERY: list[tuple[str, list[str], int]] = [
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "8", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0",
       "--prefix-mix", "3"], 1800),
     ("serve_multi_tenant",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0",
       "--prefix-mix", "4"], 1800),
     ("serve_lora",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0",
       "--prefix-mix", "3", "--lora-rank", "2"], 1800),
+    # cache hierarchy (PR 16): one knob each — serve_continuity + the
+    # longtail phase (hierarchy ON vs pool-only OFF in one run), then
+    # + the warm-restart persistence leg
+    ("serve_spill",
+     ["benchmarks/bench_serving.py", "--mode", "static",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0",
+      "--longtail-mix", "6"], 1800),
+    ("serve_warm_restart",
+     ["benchmarks/bench_serving.py", "--mode", "static",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0",
+      "--longtail-mix", "6", "--persist-cache"], 1800),
     ("ring_attention_1024",
      ["benchmarks/bench_ring_attention.py", "--seq-len", "1024"], 1500),
     ("ring_attention_2048",

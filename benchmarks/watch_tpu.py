@@ -52,6 +52,8 @@ FIRST_WINDOW = [
     "serve_prefix_cache",      # prefix-sharing COW cache A/B (PR 12),
     "serve_multi_tenant",      # + fair-share tenancy under burst,
     "serve_lora",              # + batched multi-LoRA decode
+    "serve_spill",             # KV cache hierarchy A/B (PR 16),
+    "serve_warm_restart",      # + warm cache persistence leg
     "gpt2_pp_fused_ce",
     "gpt2_pp_gpipe",
     "gpt2_flash_seq1024",

@@ -183,11 +183,19 @@ def absorb_engine(reg: Registry, health: dict) -> None:
     for k in ("resident", "queued", "live_blocks", "prefix_nodes"):
         if k in health:
             reg.gauge(f"dtg_serve_{k}").set(health[k])
+    # host spill tier (PR 16): instantaneous occupancy of the host
+    # BlockStore under the device pool
+    for k in ("host_blocks", "host_bytes"):
+        if k in health:
+            reg.gauge(f"dtg_serve_spill_{k}").set(health[k])
     if "last_tick_s" in health:
         reg.gauge("dtg_serve_last_tick_s").set(health["last_tick_s"])
     for k in ("completed", "shed", "cancelled", "expired", "preemptions",
               "prefix_hit_tokens", "prefill_tokens_saved",
-              "prefix_evictions"):
+              "prefix_evictions", "spill_out_blocks", "spill_in_blocks",
+              "spill_d2h_bytes", "spill_h2d_bytes",
+              "spill_prefetched_blocks", "spill_resumes",
+              "swapin_tokens_saved"):
         if k in health:
             reg.counter(f"dtg_serve_{k}_total").set_total(health[k])
     if "ticks" in health:
@@ -208,6 +216,12 @@ def absorb_prefix(reg: Registry, stats: dict) -> None:
     """``PrefixIndex.stats()`` -> ``dtg_serve_prefix_*`` gauges."""
     for k, v in stats.items():
         reg.gauge(f"dtg_serve_prefix_{k}").set(v)
+
+
+def absorb_spill_store(reg: Registry, stats: dict) -> None:
+    """``BlockStore.stats()`` -> ``dtg_serve_spill_store_*`` gauges."""
+    for k, v in stats.items():
+        reg.gauge(f"dtg_serve_spill_store_{k}").set(v)
 
 
 def absorb_dispatch(reg: Registry, stats) -> None:

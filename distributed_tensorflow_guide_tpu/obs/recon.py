@@ -29,22 +29,28 @@ class Roofline:
     """Peak rates to reconcile against (bytes and flops per second).
 
     ``from_env`` reads ``DTG_PEAK_FLOPS`` / ``DTG_PEAK_HBM_BPS`` /
-    ``DTG_PEAK_ICI_BPS`` with v5e-class defaults — callers with a real
-    device table (benchmarks/common.py) should pass explicit numbers.
+    ``DTG_PEAK_ICI_BPS`` / ``DTG_PEAK_PCIE_BPS`` with v5e-class
+    defaults — callers with a real device table (benchmarks/common.py)
+    should pass explicit numbers.
     """
 
     peak_flops_s: float
     peak_hbm_bytes_s: float
     peak_ici_bytes_s: float | None = None
+    #: host<->device transfer peak (KV spill tier d2h/h2d traffic);
+    #: optional like ICI — absent means "don't reconcile swap bytes".
+    peak_pcie_bytes_s: float | None = None
 
     @classmethod
     def from_env(cls) -> "Roofline":
         ici = os.environ.get("DTG_PEAK_ICI_BPS")
+        pcie = os.environ.get("DTG_PEAK_PCIE_BPS")
         return cls(
             peak_flops_s=float(os.environ.get("DTG_PEAK_FLOPS", 1.97e14)),
             peak_hbm_bytes_s=float(
                 os.environ.get("DTG_PEAK_HBM_BPS", 8.19e11)),
-            peak_ici_bytes_s=float(ici) if ici else None)
+            peak_ici_bytes_s=float(ici) if ici else None,
+            peak_pcie_bytes_s=float(pcie) if pcie else None)
 
 
 def _get(cost, name: str) -> float:

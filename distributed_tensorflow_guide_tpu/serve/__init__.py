@@ -17,6 +17,7 @@ from distributed_tensorflow_guide_tpu.serve.scheduler import (
 )
 from distributed_tensorflow_guide_tpu.serve.paged_cache import (
     BlockPool,
+    BlockStore,
     blocks_for,
     gather_view,
     scatter_chunk,
@@ -32,6 +33,7 @@ from distributed_tensorflow_guide_tpu.serve.scheduler import (
 
 __all__ = [
     "BlockPool",
+    "BlockStore",
     "EngineOverloaded",
     "Event",
     "PrefixIndex",

@@ -52,8 +52,12 @@ make every recovery path *bitwise-safe*:
 from __future__ import annotations
 
 import dataclasses
+import glob
+import io as _io
 import json
+import os
 import time
+import zlib
 from types import SimpleNamespace
 
 import jax
@@ -72,7 +76,11 @@ from distributed_tensorflow_guide_tpu.models.transformer import (
     TransformerConfig,
 )
 from distributed_tensorflow_guide_tpu.obs import events as obs_events
-from distributed_tensorflow_guide_tpu.serve.paged_cache import table_row
+from distributed_tensorflow_guide_tpu.serve.paged_cache import (
+    BlockStore,
+    table_row,
+)
+from distributed_tensorflow_guide_tpu.serve.prefix_index import CACHE_RID
 from distributed_tensorflow_guide_tpu.serve.scheduler import (
     DECODE,
     PREFILL,
@@ -162,6 +170,28 @@ def init_adapter_bank(cfg: TransformerConfig):
     starts bitwise-base until its rows are written."""
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                         adapter_bank_shapes(cfg))
+
+
+@jax.jit
+def _pool_gather(pool, idx):
+    """KV spill d2h: rows ``idx`` of every pool leaf, ONE dispatch for
+    the whole tree.  Not a step program — jit-cached per (pool shapes,
+    idx width); the engine pads every batch to a multiple of 8 so only
+    one width ever compiles, at init-warmup time."""
+    return [leaf[idx] for leaf in jax.tree.leaves(pool)]
+
+
+@jax.jit
+def _pool_scatter(pool, idx, rows):
+    """KV spill h2d: write ``rows[i]`` into leaf ``i`` at ``idx``, ONE
+    dispatch for the whole tree.  Functional — the donated pool the
+    step programs alias is never mutated in place.  Duplicate indices
+    (the trash-block padding) all carry identical rows, so the scatter
+    stays deterministic."""
+    leaves, treedef = jax.tree.flatten(pool)
+    return jax.tree.unflatten(
+        treedef, [leaf.at[idx].set(r.astype(leaf.dtype))
+                  for leaf, r in zip(leaves, rows)])
 
 
 _STEP_FNS = {}
@@ -285,6 +315,7 @@ class ServeEngine:
                  retry_base_delay_s: float = 0.05,
                  snapshot_dir=None, snapshot_keep: int = 3,
                  prefix_cache: bool = False,
+                 host_blocks: int = 0, persist_cache: bool = False,
                  tenant_quotas=None, drr_quantum: int | None = None,
                  adapters=None, recorder=None):
         self.fns = build_step_fns(
@@ -293,6 +324,30 @@ class ServeEngine:
             temperature=temperature, top_k=top_k)
         self.params = params
         self.num_slots = slots
+        # cache hierarchy (PR 16): host_blocks > 0 attaches a host-RAM
+        # spill tier of that many blocks under the device pool —
+        # preemption and trie eviction demote instead of destroy, and
+        # the scheduler swaps demoted blocks back in (prefetched ahead
+        # of admission). 0 = off: byte-identical to the pool-only
+        # engine. The swap path is ENTIRELY host-side eager copies —
+        # it never touches the two compiled step programs.
+        if host_blocks < 0:
+            raise ValueError(f"host_blocks must be >= 0, got {host_blocks}")
+        if persist_cache:
+            if snapshot_dir is None:
+                raise ValueError(
+                    "persist_cache requires ServeEngine(snapshot_dir=...)")
+            if not prefix_cache:
+                raise ValueError(
+                    "persist_cache requires prefix_cache=True (the trie "
+                    "is what indexes the persisted blocks)")
+            if not host_blocks:
+                raise ValueError(
+                    "persist_cache requires host_blocks > 0 (restored "
+                    "cache contents land in the host tier)")
+        self.persist_cache = bool(persist_cache)
+        self.store = (BlockStore(capacity=host_blocks) if host_blocks
+                      else None)
         # observability (PR 14): strictly observe-only. Resolved ONCE
         # here; every emission site guards on ``rec.enabled`` so a
         # disabled recorder costs one attribute check per site
@@ -305,6 +360,12 @@ class ServeEngine:
             prefill_chunk=prefill_chunk, max_len=self.fns.cfg.max_len,
             max_queue=max_queue, prefix_cache=prefix_cache,
             tenant_quotas=tenant_quotas, drr_quantum=drr_quantum,
+            host_store=self.store,
+            cache_io=(SimpleNamespace(d2h=self._cache_d2h,
+                                      d2h_many=self._cache_d2h_many,
+                                      h2d=self._cache_h2d,
+                                      h2d_many=self._cache_h2d_many)
+                      if self.store is not None else None),
             recorder=self.rec)
         if self.fns.lora:
             # the bank is a jit-operand (not a closed-over constant):
@@ -321,6 +382,15 @@ class ServeEngine:
         self.pool = paged_cache_pool(self.fns.cfg, slots)
         self._trash_row = table_row(
             [], self.fns.n_blk, self.sched.pool.trash_block)
+        if self.store is not None:
+            # warm the d2h/h2d transfer path (the fused gather/scatter
+            # programs compile once per pool geometry at their single
+            # padded width): a roundtrip through the trash block —
+            # scratch by design, and the write-back restores its
+            # bytes — so the first REAL swap isn't charged XLA
+            # compiles mid-serve
+            trash = self.sched.pool.trash_block
+            self._cache_h2d(trash, self._cache_d2h(trash))
         self.steps = {"decode": 0, "prefill": 0, "idle": 0}
         # failure hardening (PR 11)
         self.chaos = chaos  # a testing.chaos.FaultSchedule (or None)
@@ -398,6 +468,57 @@ class ServeEngine:
         boundary. Returns False for unknown/already-terminal rids."""
         return self.sched.cancel(rid)
 
+    # ---- cache hierarchy io (PR 16) --------------------------------------
+
+    def _cache_d2h(self, block: int) -> list[np.ndarray]:
+        """Copy one pool block's rows to host — one numpy array per
+        cache-collection leaf (k, v, and the int8 scale rows when
+        quantized), in ``jax.tree.leaves`` order.  Routed through the
+        batch path so even a single-block spill costs ONE dispatch."""
+        return self._cache_d2h_many([block])[0]
+
+    def _cache_d2h_many(self, blocks: list[int]) -> list[list[np.ndarray]]:
+        """Copy several pool blocks' rows to host in ONE
+        :func:`_pool_gather` dispatch for the whole tree.  The batch is
+        padded to a multiple of 8 with trash-block rows (dropped before
+        returning) so the gather compiles at ONE width — warmed at
+        engine init, never mid-serve.  Rows are copied out of the
+        stacked result so the payloads the host store retains don't pin
+        the padded buffer."""
+        n = len(blocks)
+        pad = -(-n // 8) * 8 - n
+        trash = self.sched.pool.trash_block
+        idx = jnp.asarray(list(blocks) + [trash] * pad)
+        stacked = [np.asarray(s) for s in _pool_gather(self.pool, idx)]
+        return [[s[j].copy() for s in stacked] for j in range(n)]
+
+    def _cache_h2d(self, block: int, payload: list[np.ndarray]) -> None:
+        """Write a host payload into device pool block ``block`` — the
+        single-block face of :meth:`_cache_h2d_many`."""
+        self._cache_h2d_many([block], [payload])
+
+    def _cache_h2d_many(self, blocks: list[int],
+                        payloads: list[list[np.ndarray]]) -> None:
+        """Write several host payloads into their device pool blocks in
+        ONE :func:`_pool_scatter` dispatch for the whole tree —
+        functional updates, so the donated pool the step programs alias
+        is never mutated behind XLA's back.  Per-op dispatch overhead
+        dominates the eager swap path, which is why the whole tree
+        fuses into one program and why the batch is padded to a
+        multiple of 8 with writes of the first payload into the trash
+        block (scratch by design): one compiled width, warmed at
+        engine init — a varying-width batch would reintroduce mid-serve
+        compile stalls."""
+        n = len(blocks)
+        pad = -(-n // 8) * 8 - n
+        trash = self.sched.pool.trash_block
+        idx = jnp.asarray(list(blocks) + [trash] * pad)
+        rows = [jnp.asarray(np.stack(
+                    [np.asarray(p[i]) for p in payloads]
+                    + [np.asarray(payloads[0][i])] * pad))
+                for i in range(len(payloads[0]))]
+        self.pool = _pool_scatter(self.pool, idx, rows)
+
     # ---- the tick --------------------------------------------------------
 
     def step(self, now: float = 0.0) -> tuple[list[Event], str]:
@@ -418,6 +539,12 @@ class ServeEngine:
             self._apply_chaos(tick, now)
         self._release_pressure(tick)
         events = [Event(now, *t) for t in self.sched.sweep(now)]
+        if self.store is not None:
+            # prefetch ahead of schedule: queued spilled continuations'
+            # h2d copies land NOW, before this tick's launch, so a
+            # swap-in resume at a later admit finds its blocks already
+            # on device instead of serializing the copies with it
+            self.sched.prefetch()
         self.sched.admit(now)
         kind, arg = self.sched.plan()
         launch = None
@@ -694,6 +821,17 @@ class ServeEngine:
             "prefill_tokens_saved": sd.prefill_tokens_saved,
             "prefix_evictions": sd.prefix_evictions,
             "prefix_nodes": sd.prefix.size if sd.prefix is not None else 0,
+            "spill_out_blocks": sd.spill_out_blocks,
+            "spill_in_blocks": sd.spill_in_blocks,
+            "spill_d2h_bytes": sd.spill_d2h_bytes,
+            "spill_h2d_bytes": sd.spill_h2d_bytes,
+            "spill_prefetched_blocks": sd.spill_prefetched_blocks,
+            "spill_resumes": sd.spill_resumes,
+            "swapin_tokens_saved": sd.swapin_tokens_saved,
+            "host_blocks": (self.store.live_blocks()
+                            if self.store is not None else 0),
+            "host_bytes": (self.store.bytes_stored()
+                           if self.store is not None else 0),
             "tenants": {t: dict(c) for t, c in sorted(sd.tenants.items())},
             "last_tick_s": self.last_tick_s,
             "ticks": self._tick,
@@ -725,6 +863,8 @@ class ServeEngine:
                                async_=async_):
             return None
         self._last_snap = label
+        if self.persist_cache:
+            self._save_cache_contents(label)
         if self.rec.enabled:
             self.rec.emit(
                 "snapshot.save", cat="serve", actor="engine",
@@ -732,6 +872,114 @@ class ServeEngine:
                          "requests": len(state["sched"]["requests"]),
                          "async": bool(async_)})
         return label
+
+    def _cache_file(self, label: int) -> str:
+        return os.path.join(str(self.snapshot_dir),
+                            f"cache_{int(label)}.npz")
+
+    def _save_cache_contents(self, label: int) -> int:
+        """Persist the prefix trie's PAYLOADS (device-resident blocks
+        d2h'd, spilled blocks straight from the host tier) next to
+        snapshot ``label`` as one npz + a CRC sidecar — the warm-restart
+        path: a restored engine swallows these into the host tier and
+        re-prefills ZERO cached-prefix tokens.  Returns the number of
+        nodes written."""
+        sd = self.sched
+        nodes = []
+        arrays = {}
+        for j, (adapter, path, node) in enumerate(sd.prefix.walk()):
+            payload = (self._cache_d2h(node.block)
+                       if node.block is not None
+                       else sd.store.get(node.host))
+            nodes.append({"adapter": int(adapter),
+                          "path": [int(t) for t in path]})
+            for k, a in enumerate(payload):
+                arrays[f"n{j}_l{k}"] = np.asarray(a)
+        sig = [[list(leaf.shape[1:]), str(leaf.dtype)]
+               for leaf in jax.tree.leaves(self.pool)]
+        meta = json.dumps({"version": 1, "label": int(label),
+                           "leaves": sig, "nodes": nodes})
+        path = self._cache_file(label)
+        buf = _io.BytesIO()
+        np.savez(buf, meta=np.frombuffer(meta.encode("utf-8"), np.uint8),
+                 **arrays)
+        raw = buf.getvalue()
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(raw)
+        os.replace(tmp, path)
+        with open(path[:-4] + ".crc", "w") as f:
+            f.write(str(zlib.crc32(raw)))
+        # trim cache files alongside the checkpointer's max_to_keep
+        keep = {self._cache_file(s) for s in self._ckpt.all_steps()}
+        for old in glob.glob(os.path.join(str(self.snapshot_dir),
+                                          "cache_*.npz")):
+            if old not in keep:
+                for p in (old, old[:-4] + ".crc"):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+        if self.rec.enabled:
+            self.rec.emit("snapshot.cache_save", cat="serve",
+                          actor="engine",
+                          payload={"label": int(label),
+                                   "nodes": len(nodes),
+                                   "bytes": len(raw)})
+        return len(nodes)
+
+    def _restore_cache_contents(self, label: int) -> int:
+        """Warm-restore the cache file for snapshot ``label`` into the
+        HOST tier: every node re-enters the trie as a spilled entry
+        (zero device blocks consumed) and promotes on demand when a
+        claim wants it.  Any failure — missing file, CRC mismatch,
+        signature drift, truncation — falls back to a cold cache (the
+        continuations simply re-prefill; never a wrong token).  Returns
+        the number of nodes restored."""
+        sd = self.sched
+        path = self._cache_file(label)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            with open(path[:-4] + ".crc") as f:
+                want = int(f.read().strip())
+            if zlib.crc32(raw) != want:
+                raise ValueError("cache file CRC mismatch")
+            data = np.load(_io.BytesIO(raw))
+            meta = json.loads(bytes(data["meta"]).decode("utf-8"))
+            sig = [[list(leaf.shape[1:]), str(leaf.dtype)]
+                   for leaf in jax.tree.leaves(self.pool)]
+            if meta.get("version") != 1 or meta["leaves"] != sig:
+                raise ValueError("cache file leaf signature mismatch")
+            restored = 0
+            for j, nd in enumerate(meta["nodes"]):
+                payload = [np.asarray(data[f"n{j}_l{k}"])
+                           for k in range(len(sig))]
+                for a, (shape, dtype) in zip(payload, sig):
+                    if list(a.shape) != shape or str(a.dtype) != dtype:
+                        raise ValueError(
+                            "cache file node payload shape mismatch")
+                h = sd.store.put(CACHE_RID, payload)
+                if h is None:
+                    break  # host tier full — keep what fits
+                if sd.prefix.insert_spilled(nd["path"], h,
+                                            adapter=int(nd["adapter"])):
+                    restored += 1
+                else:
+                    sd.store.free(CACHE_RID, [h])
+        except Exception as e:
+            if self.rec.enabled:
+                self.rec.emit("snapshot.cache_restore_miss", cat="serve",
+                              actor="engine",
+                              payload={"label": int(label),
+                                       "error": str(e)})
+            return 0
+        if self.rec.enabled:
+            self.rec.emit("snapshot.cache_restore", cat="serve",
+                          actor="engine",
+                          payload={"label": int(label),
+                                   "nodes": restored})
+        return restored
 
     def restore_latest_snapshot(self) -> int | None:
         """Restore the newest VALID snapshot (the PR-5 ladder: a
@@ -754,6 +1002,12 @@ class ServeEngine:
         state = json.loads(
             np.asarray(tree["blob"], np.uint8).tobytes().decode("utf-8"))
         self.sched.restore_state(state["sched"])
+        if self.persist_cache:
+            # warm the trie BEFORE the first admit so every restored
+            # continuation routes through the prefix-claim path and
+            # re-prefills only its suffix (the fix-of-opportunity:
+            # restore cost scales with suffix length, not prompt length)
+            self._restore_cache_contents(label)
         self._tick = int(state["tick"])
         for k, v in state["steps"].items():
             self.steps[k] = int(v)
@@ -767,9 +1021,12 @@ class ServeEngine:
 
     def close(self) -> None:
         """Release background resources (watchdog thread, checkpointer)
-        and drop the prefix cache's block references so
-        ``pool.check_leaks()`` audits clean after shutdown."""
+        and drop the prefix cache's block references — device AND host
+        tier — plus any banked spill records, so the joint
+        ``Scheduler.check_leaks()`` audits clean after shutdown."""
         self.sched.release_prefix_cache()
+        if self.store is not None:
+            self.sched.release_spill_store()
         if self._watchdog is not None:
             self._watchdog.close()
         if self._ckpt is not None:

@@ -122,6 +122,11 @@ class BlockPool:
         self._free: list[int] = sorted(range(self.num_blocks - 1),
                                        reverse=True)
         self._holders: dict[int, set[int]] = {}  # block id -> holder rids
+        # invoked with the block id whenever a block's refcount hits 0
+        # (the id is about to be re-handed-out and REWRITTEN) — the
+        # spill tier uses this to invalidate its device->host content
+        # dedup map the instant an association can go stale
+        self.on_recycle = None
 
     @property
     def trash_block(self) -> int:
@@ -188,6 +193,8 @@ class BlockPool:
                 del self._holders[b]
                 self._free.append(b)
                 released = True
+                if self.on_recycle is not None:
+                    self.on_recycle(b)
         if released:
             self._free.sort(reverse=True)
 
@@ -217,6 +224,120 @@ class BlockPool:
         if empty:
             raise AssertionError(
                 f"refcount leak: live blocks with no holder: {empty}")
+
+
+@dataclasses.dataclass
+class BlockStore:
+    """Host-RAM spill tier under the device :class:`BlockPool`.
+
+    Where the pool hands out *ids into a device buffer*, the store holds
+    the *payload itself*: one entry per spilled block, a list of numpy
+    rows (one per cache-collection leaf — k, v, and the int8 scale rows
+    when quantized) captured by a d2h copy at demotion time.  Holder
+    semantics deliberately mirror the pool's refcounted ledger —
+    :meth:`put` creates a block with one holder, :meth:`share` ref-bumps
+    it for another (a COW-shared device block spills ONCE and its host
+    copy is shared the same way), :meth:`free` drops a hold and deletes
+    the payload at refcount 0 — so :meth:`check_leaks` can audit the two
+    tiers with the same discipline.
+
+    ``capacity`` bounds the number of live host blocks (``None`` =
+    unbounded: host RAM is the big tier); a full store makes :meth:`put`
+    return ``None`` and the caller falls back to the destructive path
+    (re-prefill), never a wrong token.  Host ids are monotonically
+    increasing and never recycled, which keeps every (id -> content)
+    association unambiguous across a run.
+    """
+
+    capacity: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.capacity is not None and self.capacity < 1:
+            raise ValueError("BlockStore capacity must be >= 1 or None")
+        self._next = 0
+        self._payloads: dict[int, list[np.ndarray]] = {}
+        self._holders: dict[int, set[int]] = {}
+
+    def live_blocks(self) -> int:
+        return len(self._payloads)
+
+    def refcount(self, block: int) -> int:
+        return len(self._holders.get(block, ()))
+
+    def owned_by(self, rid: int) -> list[int]:
+        return sorted(b for b, h in self._holders.items() if rid in h)
+
+    def put(self, rid: int, payload: list[np.ndarray]) -> int | None:
+        """Store one spilled block for holder ``rid``; returns the host
+        block id, or None (no state change) when the store is full."""
+        if self.capacity is not None and len(self._payloads) >= self.capacity:
+            return None
+        h = self._next
+        self._next += 1
+        self._payloads[h] = payload
+        self._holders[h] = {rid}
+        return h
+
+    def get(self, block: int) -> list[np.ndarray]:
+        payload = self._payloads.get(block)
+        if payload is None:
+            raise ValueError(f"reading dead host block {block}")
+        return payload
+
+    def share(self, rid: int, blocks: list[int]) -> None:
+        """Ref-bump live host ``blocks`` for holder ``rid`` — the spill
+        analogue of :meth:`BlockPool.share` (same validation)."""
+        for b in blocks:
+            holders = self._holders.get(b)
+            if holders is None:
+                raise ValueError(
+                    f"request {rid} sharing dead host block {b}")
+            if rid in holders:
+                raise ValueError(
+                    f"request {rid} already holds host block {b}")
+        for b in blocks:
+            self._holders[b].add(rid)
+
+    def free(self, rid: int, blocks: list[int]) -> None:
+        """Drop ``rid``'s hold; payload deleted at refcount 0."""
+        for b in blocks:
+            if rid not in self._holders.get(b, ()):
+                raise ValueError(
+                    f"request {rid} freeing host block {b} it does not "
+                    f"own (holders: {sorted(self._holders.get(b, ()))})")
+        for b in blocks:
+            holders = self._holders[b]
+            holders.discard(rid)
+            if not holders:
+                del self._holders[b]
+                del self._payloads[b]
+
+    def bytes_stored(self) -> int:
+        return sum(sum(int(a.nbytes) for a in p)
+                   for p in self._payloads.values())
+
+    def stats(self) -> dict:
+        """Occupancy snapshot for the metrics plane
+        (``obs.metrics.absorb_spill_store``) — pure reads."""
+        shared = sum(1 for h in self._holders.values() if len(h) > 1)
+        return {
+            "live": len(self._payloads),
+            "shared": shared,
+            "holds": sum(len(h) for h in self._holders.values()),
+            "bytes": self.bytes_stored(),
+        }
+
+    def check_leaks(self) -> None:
+        """Every payload has a holder set and vice versa, and no live
+        host block has an empty holder set (a refcount leak)."""
+        if set(self._payloads) != set(self._holders):
+            raise AssertionError(
+                f"host tier leak: payloads {sorted(self._payloads)} != "
+                f"holders {sorted(self._holders)}")
+        empty = [b for b, h in self._holders.items() if not h]
+        if empty:
+            raise AssertionError(
+                f"host refcount leak: blocks with no holder: {empty}")
 
 
 def blocks_for(tokens: int, block_size: int) -> int:

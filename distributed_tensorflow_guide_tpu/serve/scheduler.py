@@ -46,6 +46,21 @@ admission becomes deficit-round-robin across the per-tenant queue heads
 (exactly head-of-line FIFO when one tenant is present) under optional
 per-tenant slot/block quotas, so one tenant's burst cannot starve
 another.
+
+Cache hierarchy (PR 16): with a host :class:`~.paged_cache.BlockStore`
+and a ``cache_io`` d2h/h2d adapter attached, preemption and trie LRU
+eviction become DEMOTIONS instead of destructions — the victim's written
+blocks swap out to host RAM (COW-shared blocks spill once, deduplicated
+through a device->host content map), a preempted request resumes by
+swap-in at admission instead of re-prefilling, and queued spilled
+continuations are prefetched back onto device BETWEEN ticks so the h2d
+copies land ahead of the decode launches that consume them.  The swap
+path never changes tokens: position-derived sampling keys already make a
+re-prefilled continuation bitwise-identical to the uninterrupted stream,
+and a swap-in restores the *same bytes* the re-prefill would recompute —
+the hierarchy moves cost, not content.  All device<->host traffic is
+counted (``spill_*`` counters) so the byte model in benchmarks/common.py
+can reconcile it against the PCIe roofline.
 """
 
 from __future__ import annotations
@@ -61,6 +76,7 @@ from distributed_tensorflow_guide_tpu.serve.paged_cache import (
     blocks_for,
 )
 from distributed_tensorflow_guide_tpu.serve.prefix_index import (
+    CACHE_RID,
     PrefixIndex,
 )
 
@@ -129,6 +145,7 @@ class Scheduler:
                  prefix_cache: bool = False,
                  tenant_quotas: dict[int, dict] | None = None,
                  drr_quantum: int | None = None,
+                 host_store=None, cache_io=None,
                  recorder=None) -> None:
         if max_len % prefill_chunk:
             raise ValueError(
@@ -182,6 +199,39 @@ class Scheduler:
         self.prefix_hit_tokens = 0
         self.prefill_tokens_saved = 0
         self.prefix_evictions = 0
+        # cache hierarchy (PR 16): host spill tier + d2h/h2d adapter.
+        # Both None = hierarchy off, every code path below is byte-
+        # identical to the pool-only scheduler (the determinism pins).
+        if (host_store is None) != (cache_io is None):
+            raise ValueError(
+                "host_store and cache_io come as a pair (the store holds "
+                "spilled payloads, the io adapter moves them)")
+        self.store = host_store
+        self.io = cache_io
+        # device block id -> host block id with IDENTICAL content; an
+        # entry exists only while the device block is live and immutable
+        # (COW: shared full blocks are never written; the pool's
+        # on_recycle hook drops the entry the moment a block could be
+        # re-handed-out and rewritten).  This is what makes COW-shared
+        # blocks spill ONCE: later demoters find the live host copy and
+        # ref-bump it instead of copying again.
+        self._dev_to_host: dict[int, int] = {}
+        self.pool.on_recycle = (
+            lambda b: self._dev_to_host.pop(b, None))
+        # rid -> spill record for a demoted (preempted) request:
+        # {"entries": [("host", h) | ("dev", d, h)], "written", "pending"}.
+        # A ("dev", d, h) entry is PREFETCH-STAGED: the payload is back
+        # in device block d but the host hold h is retained so staging
+        # is revocable for free under pressure.
+        self._spilled: dict[int, dict] = {}
+        self._prefetch_clock = 0
+        self.spill_out_blocks = 0
+        self.spill_in_blocks = 0
+        self.spill_d2h_bytes = 0
+        self.spill_h2d_bytes = 0
+        self.spill_prefetched_blocks = 0
+        self.spill_resumes = 0
+        self.swapin_tokens_saved = 0
         # observability (PR 14): observe-only. The engine passes its
         # recorder so both sides share one event stream, and refreshes
         # ``now`` (the semantic clock) at the top of every tick.
@@ -287,10 +337,19 @@ class Scheduler:
                 if self._deficit[tenant] < cost:
                     deficit_waiting = True
                     continue
-                claim = self._claim_blocks(req)
-                if claim is None:
-                    continue
-                blocks, prefix_len = claim
+                record = self._spilled.get(req.rid)
+                if record is not None:
+                    # demoted continuation: resume by swap-in — phase
+                    # DECODE with the restored cache, zero re-prefill
+                    blocks = self._swap_in_record(req.rid, record)
+                    if blocks is None:
+                        continue
+                    prefix_len = 0
+                else:
+                    claim = self._claim_blocks(req)
+                    if claim is None:
+                        continue
+                    blocks, prefix_len = claim
                 # remove by IDENTITY: dataclass equality would compare
                 # numpy prompt arrays elementwise
                 self.queue.pop(next(
@@ -303,6 +362,21 @@ class Scheduler:
                     written=prefix_len, admitted_seq=self._seq,
                     tenant=int(req.tenant), adapter=int(req.adapter),
                     prefix_len=prefix_len, max_blocks=cost)
+                if record is not None:
+                    del self._spilled[req.rid]
+                    resumed = self.slots[s]
+                    resumed.phase = DECODE
+                    resumed.written = int(record["written"])
+                    resumed.pending = int(record["pending"])
+                    self.spill_resumes += 1
+                    self.swapin_tokens_saved += int(record["written"])
+                    if self.rec.enabled:
+                        self.rec.emit(
+                            "spill.resume", cat="serve",
+                            actor="scheduler",
+                            payload={"rid": req.rid, "slot": s,
+                                     "written": int(record["written"])},
+                            t=self.now)
                 self._seq += 1
                 self._deficit[tenant] -= cost
                 self._tc(tenant)["admitted"] += 1
@@ -368,6 +442,365 @@ class Scheduler:
                 return False
         return True
 
+    # ---- cache hierarchy: demotion / swap-in / prefetch (PR 16) ----------
+
+    def _payload_bytes(self, payload) -> int:
+        return sum(int(a.nbytes) for a in payload)
+
+    def _demote_block(self, rid: int, block: int) -> int | None:
+        """Move holder ``rid``'s interest in device ``block`` to the host
+        tier: returns a host block id holding ``block``'s content, or
+        None (no state change) when the store is full.  Deduplicated —
+        if a live host copy of this exact content already exists
+        (``_dev_to_host``), it is ref-bumped instead of copied, so a
+        COW-shared block spills once no matter how many holders demote
+        it.  Does NOT drop the pool hold; the caller frees the device
+        block after banking the returned host id."""
+        return self._demote_blocks(rid, [block])[0]
+
+    def _demote_blocks(self, rid: int, blocks: list[int]) -> list:
+        """Batched :meth:`_demote_block`: one d2h gather dispatch per
+        pool leaf for the subset that actually needs copying (dedup
+        hits just ref-bump).  Mirrors :meth:`_swap_in_blocks` — per-op
+        dispatch overhead dominates single-block transfers, so both
+        directions of the swap path batch.  Returns a per-block list of
+        host ids with None entries where the store filled up (those
+        blocks are left untouched)."""
+        dedup = []
+        copy_blocks = []
+        for b in blocks:
+            h = self._dev_to_host.get(b)
+            if h is None or self.store.refcount(h) == 0:
+                h = None
+                copy_blocks.append(b)
+            dedup.append(h)
+        d2h_many = getattr(self.io, "d2h_many", None)
+        if d2h_many is not None and copy_blocks:
+            payloads = dict(zip(copy_blocks, d2h_many(copy_blocks)))
+        else:
+            payloads = {b: self.io.d2h(b) for b in copy_blocks}
+        out = []
+        full = False
+        for b, h in zip(blocks, dedup):
+            if h is not None:
+                self.store.share(rid, [h])
+            elif full:
+                out.append(None)
+                continue
+            else:
+                p = payloads[b]
+                h = self.store.put(rid, p)
+                if h is None:
+                    full = True
+                    out.append(None)
+                    continue
+                self._dev_to_host[b] = h
+                self.spill_d2h_bytes += self._payload_bytes(p)
+            self.spill_out_blocks += 1
+            out.append(h)
+        return out
+
+    def _swap_in_block(self, rid: int, dst: int, host: int) -> None:
+        """h2d one host block into device block ``dst`` (already
+        allocated to ``rid``); the host hold is NOT dropped here."""
+        self._swap_in_blocks(rid, [(dst, host)])
+
+    def _swap_in_blocks(self, rid: int,
+                        pairs: list[tuple[int, int]]) -> None:
+        """h2d a batch of host blocks into already-allocated device
+        blocks — one dispatch per pool leaf when the io adapter offers
+        ``h2d_many``.  The eager scatter's per-op dispatch overhead is
+        the swap path's dominant cost and it amortizes across the
+        batch, so every multi-block swap-in (record resume, prefetch,
+        multi-node claim promotion) routes through here.  Host holds
+        are NOT dropped here."""
+        if not pairs:
+            return
+        payloads = [self.store.get(h) for _, h in pairs]
+        h2d_many = getattr(self.io, "h2d_many", None)
+        if h2d_many is not None:
+            h2d_many([d for d, _ in pairs], payloads)
+        else:
+            for (d, _), p in zip(pairs, payloads):
+                self.io.h2d(d, p)
+        self.spill_in_blocks += len(pairs)
+        self.spill_h2d_bytes += sum(
+            self._payload_bytes(p) for p in payloads)
+
+    def _reclaim_one(self, reason: str) -> bool:
+        """Free one device block, cheapest-first: revoke a prefetch-staged
+        block (free — the host copy was retained), demote the coldest
+        trie block to host (one d2h copy, trie structure preserved), and
+        only then the destructive LRU leaf eviction.  With the hierarchy
+        off this is EXACTLY the legacy behavior: only the destructive
+        branch exists."""
+        if self.store is not None and self._revoke_prefetch():
+            return True
+        if self.prefix is not None and self.store is not None:
+            freed = self.prefix.demote_many(
+                self.pool, self._cache_demote_batch, limit=8)
+            if freed:
+                if self.rec.enabled:
+                    self.rec.emit("spill.demote", cat="serve",
+                                  actor="scheduler",
+                                  payload={"blocks": freed,
+                                           "reason": reason}, t=self.now)
+                return True
+        if (self.prefix is not None
+                and self.prefix.evict_one(self.pool) is not None):
+            self.prefix_evictions += 1
+            if self.rec.enabled:
+                self.rec.emit("prefix.evict", cat="serve",
+                              actor="scheduler",
+                              payload={"reason": reason}, t=self.now)
+            return True
+        return False
+
+    def _cache_demote(self, block: int) -> int | None:
+        """The trie's demote callable: spill for CACHE_RID and drop the
+        cache's pool hold on success."""
+        h = self._demote_block(CACHE_RID, block)
+        if h is not None:
+            self.pool.free(CACHE_RID, [block])
+        return h
+
+    def _cache_demote_batch(self, blocks: list[int]) -> list:
+        """Batch form of :meth:`_cache_demote` for the trie's
+        :meth:`~.prefix_index.PrefixIndex.demote_many`."""
+        hs = self._demote_blocks(CACHE_RID, blocks)
+        self.pool.free(CACHE_RID,
+                       [b for b, h in zip(blocks, hs) if h is not None])
+        return hs
+
+    def _promote_nodes(self, nodes) -> list[int] | None:
+        """Swap a batch of spilled trie nodes' payloads back onto device
+        (one h2d dispatch per pool leaf) so a claim can ref-bump them.
+        Returns the new device block ids in node order, or None when the
+        blocks cannot be found even after reclaim (the claim falls back
+        to re-prefill).  Safe against self-reclaim: the claim shares
+        every device-resident node of its chain BEFORE promoting, so
+        reclaim can neither demote nor evict a block the claim stands
+        on, and spilled nodes are untouchable by either ladder rung."""
+        got = self.pool.alloc(CACHE_RID, len(nodes))
+        while got is None and self._reclaim_one("promote"):
+            got = self.pool.alloc(CACHE_RID, len(nodes))
+        if got is None:
+            return None
+        self._swap_in_blocks(
+            CACHE_RID, [(d, n.host) for d, n in zip(got, nodes)])
+        for d, node in zip(got, nodes):
+            h = node.host
+            self.store.free(CACHE_RID, [h])
+            if self.store.refcount(h) > 0:
+                self._dev_to_host[d] = h
+            node.block = d
+            node.host = None
+        return got
+
+    def _demote_slot(self, slot: _Slot) -> bool:
+        """Preemption as demotion: spill the victim's WRITTEN blocks to
+        host and bank a spill record so admission resumes it by swap-in
+        (phase DECODE, zero re-prefill) instead of re-prefilling.
+        Only decode-phase victims qualify — a mid-prefill victim has
+        cheap state to rebuild and its partial chunks are not all
+        block-aligned.  Returns False (caller frees destructively) when
+        the hierarchy is off or the store cannot take the copies."""
+        if self.store is None or slot.phase != DECODE or slot.written < 1:
+            return False
+        n_keep = blocks_for(slot.written, self.block_size)
+        keep = slot.blocks[:n_keep]
+        if self.store.capacity is not None:
+            new_copies = sum(
+                1 for b in keep
+                if (h := self._dev_to_host.get(b)) is None
+                or self.store.refcount(h) == 0)
+            if (self.store.live_blocks() + new_copies
+                    > self.store.capacity):
+                return False
+        hs = self._demote_blocks(slot.rid, keep)
+        if any(h is None for h in hs):
+            # bounded store pre-checked above — defensive
+            self.store.free(slot.rid, [h for h in hs if h is not None])
+            return False
+        entries: list[tuple] = [("host", h) for h in hs]
+        self.pool.free(slot.rid, slot.blocks)
+        self._spilled[slot.rid] = {
+            "entries": entries,
+            "written": int(slot.written),
+            "pending": int(slot.pending),
+        }
+        if self.rec.enabled:
+            self.rec.emit("spill.out", cat="serve", actor="scheduler",
+                          payload={"rid": slot.rid, "blocks": n_keep,
+                                   "written": int(slot.written)},
+                          t=self.now)
+        return True
+
+    def _swap_in_record(self, rid: int, record: dict) -> list[int] | None:
+        """Materialize a spill record's blocks on device for admission.
+        Staged entries already own their device block (drop the retained
+        host hold); unstaged entries h2d into freshly allocated blocks.
+        All-or-nothing: on allocation failure nothing changes and the
+        record stays banked for a later tick."""
+        entries = record["entries"]
+        # recompute `need` after every reclaim: a reclaim can revoke a
+        # staged entry of THIS record (it is still queued), flipping a
+        # ("dev", ...) entry back to ("host", ...)
+        while True:
+            need = sum(1 for e in entries if e[0] == "host")
+            fresh = self.pool.alloc(rid, need)
+            if fresh is not None:
+                break
+            if not self._reclaim_one("swap_in"):
+                return None
+        blocks: list[int] = []
+        hosts: list[int] = []
+        fi = 0
+        bs = self.block_size
+        for e in entries:
+            if e[0] == "dev":
+                blocks.append(e[1])
+                hosts.append(e[2])
+            else:
+                blocks.append(fresh[fi])
+                hosts.append(e[1])
+                fi += 1
+        self._swap_in_blocks(rid, [
+            (blocks[j], hosts[j]) for j, e in enumerate(entries)
+            if e[0] == "host"])
+        for j, (d, h) in enumerate(zip(blocks, hosts)):
+            self.store.free(rid, [h])
+            # bank the content association only for FULL immutable
+            # blocks — the partial tail block is rewritten by decode
+            if ((j + 1) * bs <= record["written"]
+                    and self.store.refcount(h) > 0):
+                self._dev_to_host[d] = h
+        return blocks
+
+    def prefetch(self) -> int:
+        """Stage queued spilled continuations' host blocks back onto
+        device AHEAD of admission (the engine calls this between sweep
+        and admit every tick), so the h2d copies overlap decode launches
+        instead of serializing with the resume.  Greedy in queue order,
+        but never below a growth reserve of one free block per resident
+        slot — staging must not starve decode growth into preempting
+        somebody.  Staged blocks keep their host hold (revocable for
+        free).  Returns the number of blocks staged."""
+        if self.store is None or not self._spilled:
+            return 0
+        self._prefetch_clock += 1
+        staged = 0
+        resident = sum(1 for s in self.slots if s is not None)
+        for req in self.queue:
+            record = self._spilled.get(req.rid)
+            if record is None:
+                continue
+            # a recently revoked record sits out a few ticks — without
+            # the cooldown a tight pool thrashes stage -> revoke ->
+            # re-stage, paying a real h2d copy each lap
+            if record.get("cool_until", 0) > self._prefetch_clock:
+                continue
+            todo = [(j, e[1])
+                    for j, e in enumerate(record["entries"])
+                    if e[0] == "host"]
+            if not todo:
+                continue
+            if self.pool.free_blocks - len(todo) < resident:
+                continue  # not enough headroom for the WHOLE record
+            got = self.pool.alloc(req.rid, len(todo))
+            if got is None:
+                return staged
+            self._swap_in_blocks(req.rid, [
+                (d, h) for d, (_, h) in zip(got, todo)])
+            for d, (j, h) in zip(got, todo):
+                record["entries"][j] = ("dev", d, h)
+                if ((j + 1) * self.block_size <= record["written"]
+                        and self.store.refcount(h) > 0):
+                    self._dev_to_host[d] = h
+                self.spill_prefetched_blocks += 1
+                staged += 1
+        if staged and self.rec.enabled:
+            self.rec.emit("spill.prefetch", cat="serve",
+                          actor="scheduler",
+                          payload={"blocks": staged}, t=self.now)
+        return staged
+
+    def _revoke_prefetch(self) -> bool:
+        """Un-stage ONE prefetched block to relieve pool pressure — the
+        host hold was retained, so this frees a device block without
+        losing anything.  Deepest-queued record, last entry first (the
+        work farthest from being needed)."""
+        for req in reversed(self.queue):
+            record = self._spilled.get(req.rid)
+            if record is None:
+                continue
+            for j in range(len(record["entries"]) - 1, -1, -1):
+                e = record["entries"][j]
+                if e[0] == "dev":
+                    _, d, h = e
+                    self.pool.free(req.rid, [d])
+                    record["entries"][j] = ("host", h)
+                    record["cool_until"] = self._prefetch_clock + 8
+                    return True
+        return False
+
+    def _drop_spill_record(self, rid: int) -> None:
+        """Release every hold a spill record owns (terminal sweep of a
+        queued spilled continuation, or engine shutdown)."""
+        record = self._spilled.pop(rid, None)
+        if record is None:
+            return
+        for e in record["entries"]:
+            if e[0] == "dev":
+                _, d, h = e
+                self.pool.free(rid, [d])
+                self.store.free(rid, [h])
+            else:
+                self.store.free(rid, [e[1]])
+
+    def release_spill_store(self) -> int:
+        """Drop every spill record (engine close).  Trie host holds are
+        released by :meth:`release_prefix_cache`.  Returns the number of
+        records dropped."""
+        rids = list(self._spilled)
+        for rid in rids:
+            self._drop_spill_record(rid)
+        return len(rids)
+
+    def check_leaks(self) -> None:
+        """Joint device+host ledger audit: the pool and store invariants,
+        plus the cross-tier ones — every spill-record entry holds what it
+        claims on both tiers, every spilled trie node's host block is
+        held for the cache, and the dedup map only keys live device
+        blocks."""
+        self.pool.check_leaks()
+        if self.store is None:
+            return
+        self.store.check_leaks()
+        for rid, record in self._spilled.items():
+            host_owned = set(self.store.owned_by(rid))
+            dev_owned = set(self.pool.owned_by(rid))
+            for e in record["entries"]:
+                h = e[2] if e[0] == "dev" else e[1]
+                if h not in host_owned:
+                    raise AssertionError(
+                        f"spill record {rid}: host block {h} not held")
+                if e[0] == "dev" and e[1] not in dev_owned:
+                    raise AssertionError(
+                        f"spill record {rid}: staged device block "
+                        f"{e[1]} not held")
+        if self.prefix is not None:
+            cache_host = set(self.store.owned_by(CACHE_RID))
+            for _, _, node in self.prefix.walk():
+                if node.block is None and node.host not in cache_host:
+                    raise AssertionError(
+                        f"spilled trie node host block {node.host} "
+                        "not held for CACHE_RID")
+        for d in self._dev_to_host:
+            if self.pool.refcount(d) == 0:
+                raise AssertionError(
+                    f"dedup map keys recycled device block {d}")
+
     def _claim_blocks(self, req: Request) -> tuple[list[int], int] | None:
         """The request's admission blocks: cached-prefix blocks claimed by
         ref-bump first (prefix cache on), then fresh blocks for the rest
@@ -382,20 +815,53 @@ class Scheduler:
         shared: list[int] = []
         prefix_len = 0
         if self.prefix is not None:
-            hit = self.prefix.match(req.prompt, adapter=int(req.adapter))
-            cap = ((P - 1) // self._claim_g) * self._claim_g
-            prefix_len = min(len(hit) * self.block_size, cap)
-            shared = hit[:prefix_len // self.block_size]
-            if shared:
-                self.pool.share(req.rid, shared)
+            if self.store is not None:
+                # hierarchy on: the match may include SPILLED nodes —
+                # promote them by swap-in so the claim still saves their
+                # prefill.  Two passes: first ref-bump every device-
+                # resident node of the chain (so reclaim during the
+                # promotion allocs can never free a block the claim
+                # stands on), then promote ALL spilled nodes in one
+                # batched h2d.  On a promotion failure (pool dry even
+                # after reclaim) drop the whole claim and fall back to
+                # a plain alloc — a shorter claim could misalign the
+                # suffix chunk start.
+                hit_nodes = self.prefix.match_nodes(
+                    req.prompt, adapter=int(req.adapter))
+                cap = ((P - 1) // self._claim_g) * self._claim_g
+                prefix_len = min(len(hit_nodes) * self.block_size, cap)
+                use = hit_nodes[:prefix_len // self.block_size]
+                spilled = [n for n in use if n.block is None]
+                failed = any(n.host is None for n in spilled)
+                if not failed:
+                    for n in use:
+                        if n.block is not None:
+                            self.pool.share(req.rid, [n.block])
+                            shared.append(n.block)
+                    if spilled:
+                        promoted = self._promote_nodes(spilled)
+                        if promoted is None:
+                            failed = True
+                        else:
+                            self.pool.share(req.rid, promoted)
+                            shared = [n.block for n in use]
+                            self.swapin_tokens_saved += (
+                                len(spilled) * self.block_size)
+                if failed:
+                    if shared:
+                        self.pool.free(req.rid, shared)
+                    shared = []
+                    prefix_len = 0
+            else:
+                hit = self.prefix.match(req.prompt,
+                                        adapter=int(req.adapter))
+                cap = ((P - 1) // self._claim_g) * self._claim_g
+                prefix_len = min(len(hit) * self.block_size, cap)
+                shared = hit[:prefix_len // self.block_size]
+                if shared:
+                    self.pool.share(req.rid, shared)
         fresh = self.pool.alloc(req.rid, need - len(shared))
-        while (fresh is None and self.prefix is not None
-               and self.prefix.evict_one(self.pool) is not None):
-            self.prefix_evictions += 1
-            if self.rec.enabled:
-                self.rec.emit("prefix.evict", cat="serve",
-                              actor="scheduler",
-                              payload={"reason": "admit"}, t=self.now)
+        while fresh is None and self._reclaim_one("admit"):
             fresh = self.pool.alloc(req.rid, need - len(shared))
         if fresh is None:
             if shared:
@@ -450,14 +916,7 @@ class Scheduler:
                 if got is not None:
                     slot.blocks.extend(got)
                     continue
-                if (self.prefix is not None
-                        and self.prefix.evict_one(self.pool) is not None):
-                    self.prefix_evictions += 1
-                    if self.rec.enabled:
-                        self.rec.emit("prefix.evict", cat="serve",
-                                      actor="scheduler",
-                                      payload={"reason": "decode_grow"},
-                                      t=self.now)
+                if self._reclaim_one("decode_grow"):
                     continue
                 victim = self._pick_victim(exclude=i)
                 if victim is None:
@@ -485,7 +944,12 @@ class Scheduler:
 
     def _preempt(self, i: int) -> None:
         slot = self.slots[i]
-        self.pool.free(slot.rid, slot.blocks)
+        # hierarchy on: demote the written blocks to host instead of
+        # destroying them — the continuation below still queues, but
+        # admission resumes it by swap-in with zero re-prefill
+        spilled = self._demote_slot(slot)
+        if not spilled:
+            self.pool.free(slot.rid, slot.blocks)
         # continuation request: this residency's prompt plus every token
         # it emitted; budget = whatever is still owed. Position-derived
         # sampling keys make the re-run emit exactly the tokens it would
@@ -508,6 +972,7 @@ class Scheduler:
             self.rec.emit("req.preempt", cat="serve", actor="scheduler",
                           payload={"rid": slot.rid, "slot": i,
                                    "emitted": slot.emitted_here,
+                                   "spilled": spilled,
                                    "tenant": slot.tenant}, t=self.now)
 
     # ---- result application ---------------------------------------------
@@ -614,8 +1079,11 @@ class Scheduler:
                 status = self._terminal_status(req.rid, now)
                 if status is None:
                     keep.append(req)
-                elif req.rid not in self.finished:
-                    out.append(self._finish(req.rid, status))
+                else:
+                    if self.store is not None:
+                        self._drop_spill_record(req.rid)
+                    if req.rid not in self.finished:
+                        out.append(self._finish(req.rid, status))
             self.queue = keep
         self._cancel_pending.clear()
         return out
@@ -637,7 +1105,7 @@ class Scheduler:
         the number of blocks released."""
         if self.prefix is None:
             return 0
-        return self.prefix.drop(self.pool)
+        return self.prefix.drop(self.pool, store=self.store)
 
     # ---- snapshot / restore (PR 11) --------------------------------------
 
@@ -695,7 +1163,15 @@ class Scheduler:
                          "expired": self.expired,
                          "prefix_hit_tokens": self.prefix_hit_tokens,
                          "prefill_tokens_saved": self.prefill_tokens_saved,
-                         "prefix_evictions": self.prefix_evictions},
+                         "prefix_evictions": self.prefix_evictions,
+                         "spill_out_blocks": self.spill_out_blocks,
+                         "spill_in_blocks": self.spill_in_blocks,
+                         "spill_d2h_bytes": self.spill_d2h_bytes,
+                         "spill_h2d_bytes": self.spill_h2d_bytes,
+                         "spill_prefetched_blocks":
+                             self.spill_prefetched_blocks,
+                         "spill_resumes": self.spill_resumes,
+                         "swapin_tokens_saved": self.swapin_tokens_saved},
             "tenant_of": {str(k): int(v)
                           for k, v in self.tenant_of.items()},
             "tenants": {str(k): dict(v)
@@ -703,7 +1179,12 @@ class Scheduler:
             # the prefix trie is deliberately NOT captured: it is host
             # state derived from token ids + deterministic prefills, and
             # the restoring engine's pool is zeroed — the trie rebuilds
-            # itself as continuations re-prefill (bitwise-identical KV)
+            # itself as continuations re-prefill (bitwise-identical KV).
+            # Spill RECORDS are likewise not captured (their payloads
+            # are process RAM): a queued spilled continuation restores
+            # as an ordinary continuation and re-prefills — or claims a
+            # warm persisted prefix when the engine saved cache contents
+            # (persist_cache).  Either way the stream is unchanged.
         }
 
     def restore_state(self, snap: dict) -> None:
@@ -747,6 +1228,14 @@ class Scheduler:
         self.prefix_hit_tokens = int(c.get("prefix_hit_tokens", 0))
         self.prefill_tokens_saved = int(c.get("prefill_tokens_saved", 0))
         self.prefix_evictions = int(c.get("prefix_evictions", 0))
+        self.spill_out_blocks = int(c.get("spill_out_blocks", 0))
+        self.spill_in_blocks = int(c.get("spill_in_blocks", 0))
+        self.spill_d2h_bytes = int(c.get("spill_d2h_bytes", 0))
+        self.spill_h2d_bytes = int(c.get("spill_h2d_bytes", 0))
+        self.spill_prefetched_blocks = int(
+            c.get("spill_prefetched_blocks", 0))
+        self.spill_resumes = int(c.get("spill_resumes", 0))
+        self.swapin_tokens_saved = int(c.get("swapin_tokens_saved", 0))
         self.tenant_of = {int(k): int(v)
                           for k, v in snap.get("tenant_of", {}).items()}
         self.tenants = {int(k): {kk: int(vv) for kk, vv in v.items()}

@@ -212,13 +212,14 @@ def test_pool_and_prefix_stats_shapes():
 
     idx = PrefixIndex(block_size=4)
     assert idx.stats() == {"nodes": 0, "leaves": 0, "max_depth": 0,
-                           "adapters": 0}
+                           "adapters": 0, "spilled": 0}
     reg = obs_metrics.Registry()
     obs_metrics.absorb_pool(reg, s)
     obs_metrics.absorb_prefix(reg, idx.stats())
     snap = reg.snapshot()
     assert snap["dtg_serve_pool_live"] == 1
     assert snap["dtg_serve_prefix_nodes"] == 0
+    assert snap["dtg_serve_prefix_spilled"] == 0
 
 
 # ---- chrome trace exporter --------------------------------------------------
@@ -443,6 +444,31 @@ def test_ttft_breakdown_from_driven_engine(params):
     assert snap["dtg_serve_completed_total"] == 3
     assert snap["dtg_serve_ticks_total"] > 0
     assert snap["dtg_serve_resident"] == 0
+
+
+def test_spill_tier_absorbers_from_driven_engine(params):
+    """The host-tier gauges flow from REAL shapes — a driven hierarchy-on
+    engine's health() and its BlockStore's stats(), not hand-built dicts
+    — so the absorbers break loudly if either producer drifts."""
+    eng = _engine(CFG, params, host_blocks=8, prefix_cache=True)
+    _drive(eng)
+    sd = eng.sched
+    freed = sd.prefix.demote_many(sd.pool, sd._cache_demote_batch)
+    assert freed  # the driven prompts cached demotable full blocks
+    reg = obs_metrics.Registry()
+    obs_metrics.absorb_engine(reg, eng.health())
+    obs_metrics.absorb_spill_store(reg, eng.store.stats())
+    obs_metrics.absorb_prefix(reg, sd.prefix.stats())
+    snap = reg.snapshot()
+    assert snap["dtg_serve_spill_host_blocks"] == len(freed)
+    assert snap["dtg_serve_spill_out_blocks_total"] == len(freed)
+    assert snap["dtg_serve_spill_d2h_bytes_total"] > 0
+    assert snap["dtg_serve_spill_host_bytes"] == eng.store.bytes_stored()
+    assert snap["dtg_serve_spill_store_live"] == len(freed)
+    assert snap["dtg_serve_spill_store_holds"] == len(freed)
+    assert snap["dtg_serve_prefix_spilled"] == len(freed)
+    eng.close()
+    sd.check_leaks()
 
 
 # ---- checkpoint / elastic events --------------------------------------------
