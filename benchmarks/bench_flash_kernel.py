@@ -166,6 +166,17 @@ def main() -> None:
                secs_per_call=round(secs, 6), tuned=bool(args.tune and on_tpu),
                **roofline_extras(flops, hbm, 1, secs))
 
+    # the online front door's snapshot next to the explicit tune rows:
+    # with DTG_ONLINE_TUNE on, first-touch sweeps already happened inside
+    # the runs above, and this line attributes the wall-clock they spent
+    # (the --tune sweep rows are then redundant but harmless — the table
+    # dedupes on key)
+    ot = autotune.online_tune_stats()
+    if ot["enabled"] or ot["attempted"]:
+        print(f"[flash_kernel] online tune: enabled={ot['enabled']} "
+              f"attempted={ot['attempted']} spent={ot['spent_s']}s "
+              f"budget={ot['budget_s']}s", file=sys.stderr)
+
 
 if __name__ == "__main__":
     main()

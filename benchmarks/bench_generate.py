@@ -63,14 +63,16 @@ def main() -> None:
                     default="auto",
                     help="decode-attention impl; 'auto' = the length-"
                          "aware Pallas kernel on TPU, dense elsewhere")
-    ap.add_argument("--weight-dtype", choices=["model", "int8", "int4"],
+    ap.add_argument("--weight-dtype",
+                    choices=["model", "int8", "int4", "fp8"],
                     default="model",
                     help="projection-weight storage: 'model' keeps the "
-                         "f32/bf16 kernels, 'int8'/'int4' stores "
+                         "f32/bf16 kernels, 'int8'/'int4'/'fp8' stores "
                          "per-column-quantized kernels (int4 packed two "
-                         "per byte) with dequant fused into each matmul "
-                         "— shrinks the params term of the decode "
-                         "roofline ~4x/~8x")
+                         "per byte; fp8 = e4m3, gated on an fp8-capable "
+                         "device generation) with dequant fused into "
+                         "each matmul — shrinks the params term of the "
+                         "decode roofline ~4x/~8x/~4x")
     ap.add_argument("--spec-draft-layers", type=int, default=0,
                     help="self-speculative decoding: draft with this many "
                          "leading layers of the same model (0 = off)")
@@ -114,6 +116,12 @@ def main() -> None:
             gpt2_124m(),
             max_len=max(1024, args.prompt_len + args.max_new + lookahead))
     wq = args.weight_dtype if args.weight_dtype != "model" else None
+    if wq == "fp8":
+        from distributed_tensorflow_guide_tpu.core.precision import (
+            require_fp8,
+        )
+
+        require_fp8()  # pre-fp8 generations would emulate at a net loss
     cfg = dataclasses.replace(
         cfg,
         kv_dtype="int8" if args.kv_dtype == "int8" else None,
@@ -129,8 +137,8 @@ def main() -> None:
     if wq:
         from distributed_tensorflow_guide_tpu.ops import quant
 
-        params = quant.quantize_params(params, bits=8 if wq == "int8"
-                                       else 4)
+        params = quant.quantize_params(
+            params, bits={"int8": 8, "int4": 4, "fp8": "fp8"}[wq])
 
     gen = make_generate_fn(cfg, max_new_tokens=args.max_new,
                            temperature=args.temperature, top_k=args.top_k,

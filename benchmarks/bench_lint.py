@@ -40,6 +40,12 @@ def main() -> int:
     ap.add_argument("--cost", action="store_true",
                     help="print the derived cost table and report the "
                          "CostSpec pin pass count")
+    ap.add_argument("--regress", action="store_true",
+                    help="also run the continuous regression gate "
+                         "(analysis/regress.py): selftest it, then flag "
+                         "measured/modeled drift in the persisted bench "
+                         "history; reports regress_programs_pass and "
+                         "fails the smoke on unexplained drift")
     ap.add_argument("--small", action="store_true",
                     help="accepted for smoke-suite parity (lint programs "
                          "are already toy-scale; no-op)")
@@ -55,6 +61,23 @@ def main() -> int:
         print(lint.render_text(rep), file=sys.stderr)
     if args.cost:
         print(lint.render_cost_table(rep), file=sys.stderr)
+    extra: dict = {}
+    regress_ok = True
+    if args.regress:
+        from distributed_tensorflow_guide_tpu.analysis import regress
+
+        st = regress.selftest()
+        hist = regress.check_history()
+        regress_ok = bool(st["ok"]) and bool(hist["ok"])
+        if not regress_ok:
+            print(f"regress selftest: "
+                  f"{'PASS' if st['ok'] else 'FAIL'}", file=sys.stderr)
+            print(regress.render_report(hist), file=sys.stderr)
+        # "pass count" in the smoke's vocabulary: selftest + every
+        # history group with enough entries to gate, minus the flagged
+        extra["regress_programs_pass"] = regress_ok
+        extra["regress_checked"] = hist["n_checked"]
+        extra["regress_flags"] = len(hist["flags"])
     report("lint_programs_pass", float(sum(p.ok for p in rep.programs)),
            "programs",
            n_programs=len(rep.programs),
@@ -62,8 +85,9 @@ def main() -> int:
            cost_programs_pass=rep.n_cost_pass,
            fingerprints_clean=not rep.fingerprint_drift,
            n_fingerprint_drift=len(rep.fingerprint_drift),
-           lint_seconds=round(dt, 2))
-    return 0 if rep.ok else 1
+           lint_seconds=round(dt, 2),
+           **extra)
+    return 0 if rep.ok and regress_ok else 1
 
 
 if __name__ == "__main__":

@@ -7,11 +7,23 @@ Each bench runs in its own process (separate XLA runtime, honest timing).
     python benchmarks/run_all.py --smoke    # tiny configs on 8 fake CPU
                                             # devices — schema/liveness check
 
-Any other flags are forwarded to every bench verbatim."""
+Any other flags are forwarded to every bench verbatim.
 
+Every bench run — smoke or real, including failures (recorded as a
+skip-shaped entry) — appends one row per result line to the persisted
+``bench_history/`` store (``analysis/regress.py``), the trajectory the
+``dtg-lint --regress`` gate checks for measured/modeled drift. Smoke
+entries can never contaminate a chip's baseline: the gate groups by
+``device_kind``, and the fake-CPU smoke is its own group."""
+
+import json
 import subprocess
 import sys
 from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from distributed_tensorflow_guide_tpu.analysis import regress  # noqa: E402
 
 BENCHES = [
     "bench_mnist_dp.py",      # config 1
@@ -154,8 +166,11 @@ SMOKE = {
         # geometry — this line is what puts dtg-lint inside tier-1.
         # --cost arms the derived-cost pins (CostSpec vs the
         # benchmarks/common.py closed forms) and the golden-fingerprint
-        # drift gate in the same pass
-        ["--fake-devices", "8", "--cost"],
+        # drift gate in the same pass; --regress adds the continuous
+        # regression gate (analysis/regress.py): its synthetic-history
+        # selftest always runs (the gate itself is under test in the
+        # smoke), and any persisted bench_history/ drift fails the run
+        ["--fake-devices", "8", "--cost", "--regress"],
 }
 
 
@@ -165,6 +180,8 @@ def main() -> int:
     smoke = "--smoke" in extra
     if smoke:
         extra = [a for a in extra if a != "--smoke"]
+    hist = {"device_kind": regress.detect_device_kind(),
+            "git_rev": regress.git_sha()}
     failed = []
     for name in BENCHES:
         if smoke:
@@ -174,7 +191,21 @@ def main() -> int:
         else:
             # bench.py (via the resnet delegator) takes no flags
             args = [] if name == "bench_resnet50_dp.py" else extra
-        r = subprocess.run([sys.executable, str(here / name), *args])
+        r = subprocess.run([sys.executable, str(here / name), *args],
+                           stdout=subprocess.PIPE, text=True)
+        sys.stdout.write(r.stdout)
+        sys.stdout.flush()
+        results = []
+        for ln in r.stdout.splitlines():
+            if ln.lstrip().startswith("{"):
+                try:
+                    results.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    pass
+        row = name.removesuffix(".py")
+        for res in ([x for x in results if isinstance(x, dict)]
+                    or [{"skipped": f"no result line (rc={r.returncode})"}]):
+            regress.append_entry(regress.make_entry(row, res, **hist))
         if r.returncode != 0:
             failed.append(name)
     if failed:

@@ -14,6 +14,11 @@ BERT TP), the round-4 wire-format claims (ring attention, SP comm), the
 dense-attention repro, then the rest of the suite.
 
 Use ``--only NAME...`` to re-run a subset, ``--list`` to see names.
+``--row-timeout N`` caps every row at N seconds (a time-boxed capture:
+a row the cap cuts off records a skip, not a failure). Every row —
+including skips and timeouts — also appends one entry per result line
+to the persisted ``bench_history/`` store (``analysis/regress.py``),
+which is what ``dtg-lint --regress`` gates for measured/modeled drift.
 """
 
 from __future__ import annotations
@@ -26,6 +31,9 @@ import time
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+from distributed_tensorflow_guide_tpu.analysis import regress  # noqa: E402
 
 # (name, argv, timeout_s) — argv relative to repo root.
 BATTERY: list[tuple[str, list[str], int]] = [
@@ -310,18 +318,44 @@ BATTERY: list[tuple[str, list[str], int]] = [
     # so every on-chip capture also records the cost table and the
     # fingerprint-drift verdict for the exact tree being measured
     ("lint_cost_audit",
-     ["benchmarks/bench_lint.py", "--fake-devices", "8", "--cost"], 900),
+     ["benchmarks/bench_lint.py", "--fake-devices", "8", "--cost",
+      "--regress"], 900),
 ]
 
+# battery row -> the registered lint program whose trace covers the
+# row's hot loop (analysis/contracts.py names). Lets the regression
+# gate join a drifted row to the golden-fingerprint bless that last
+# changed the trace being measured. Best-effort — rows without a traced
+# program (ResNet, the input pipelines) simply have no join.
+ROW_PROGRAMS: dict[str, str] = {
+    "fused_ce_kernel": "fused_ce_loss_grad",
+    "gpt2_pp_fused_ce": "pipeline_fused_ce_train_step",
+    "comm_overlap_dp": "dp_train_step",
+    "dp_overlap_kernel": "dp_overlap_train_step",
+    "dp_overlap_int8": "dp_overlap_int8_round",
+    "fsdp_prefetch": "fsdp_prefetch_train_step",
+    "moe_lm": "moe_train_step",
+    "dcn_hybrid_sync1": "multislice_outer_on_round",
+    "gpt2_decode": "decode_step",
+    "gpt2_decode_spec": "decode_spec_step",
+    "gpt2_decode_wq8": "serve_decode_step_wq8",
+    "serve_continuity": "serve_decode_step",
+    "serve_paged": "serve_decode_step",
+    "serve_chunked_prefill": "serve_prefill_chunk_step",
+    "serve_lora": "serve_decode_step_lora",
+}
 
-def run_one(name: str, argv: list[str], timeout: int, out) -> bool:
+
+def run_one(name: str, argv: list[str], timeout: int, out, *,
+            row_cap: int | None = None, hist: dict | None = None) -> bool:
     t0 = time.time()
     rec: dict = {"name": name, "argv": argv}
+    eff_timeout = timeout if row_cap is None else min(timeout, row_cap)
     try:
         proc = subprocess.run(
             [sys.executable, *argv], cwd=ROOT, text=True,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            timeout=timeout)
+            timeout=eff_timeout)
         rec["rc"] = proc.returncode
         lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
         results = []
@@ -335,9 +369,28 @@ def run_one(name: str, argv: list[str], timeout: int, out) -> bool:
         if proc.returncode != 0 or not results:
             rec["tail"] = lines[-8:]
     except subprocess.TimeoutExpired:
-        rec["rc"] = "timeout"
-        rec["results"] = []
+        if row_cap is not None and eff_timeout < timeout:
+            # the battery-wide cap expired, not the row's own budget: a
+            # time-boxed capture DECIDED not to wait, so this records as
+            # a skip (capable, not failed) — same contract as a bench
+            # printing its own "skipped" result line
+            rec["rc"] = 0
+            rec["results"] = [
+                {"skipped": f"row-timeout {eff_timeout}s expired"}]
+        else:
+            rec["rc"] = "timeout"
+            rec["results"] = []
     rec["secs"] = round(time.time() - t0, 1)
+    # every row leaves a history breadcrumb — skips and timeouts too
+    # (continuity evidence: "the row ran and produced nothing" is a
+    # different fact from "the row never ran"). append_entry is
+    # best-effort by contract; bookkeeping never fails the battery.
+    if hist is not None:
+        hrows = [r for r in rec["results"] if isinstance(r, dict)] or [
+            {"skipped": f"no result line (rc={rec['rc']})"}]
+        for r in hrows:
+            regress.append_entry(regress.make_entry(
+                name, r, program=ROW_PROGRAMS.get(name), **hist))
     # a bench may declare itself structurally impossible on this mesh
     # (e.g. interleaved 1F1B on one chip) by printing a result line with a
     # "skipped" reason — recorded as skipped, counted as capable (the
@@ -360,6 +413,13 @@ def main() -> None:
                     help="subset of battery names")
     ap.add_argument("--list", action="store_true")
     ap.add_argument("--out", default="")
+    ap.add_argument("--row-timeout", type=int, default=None,
+                    help="cap every row's timeout at this many seconds; "
+                         "a row the cap expires records a skip entry "
+                         "(time-boxed capture), not a failure")
+    ap.add_argument("--no-history", action="store_true",
+                    help="skip the bench_history/ regression-gate "
+                         "breadcrumbs (analysis/regress.py)")
     args = ap.parse_args()
 
     if args.list:
@@ -382,6 +442,11 @@ def main() -> None:
     outdir.mkdir(exist_ok=True)
     stamp = time.strftime("%Y%m%d_%H%M%S")
     path = Path(args.out) if args.out else outdir / f"battery_{stamp}.jsonl"
+    # history context computed ONCE (detect_device_kind imports jax in
+    # this driver process — cheap relative to one bench, not to 45)
+    hist = None if args.no_history else {
+        "device_kind": regress.detect_device_kind(),
+        "git_rev": regress.git_sha()}
     n_ok = 0
     n_recs = 0  # bench records actually written (run_one writes one each)
     try:
@@ -389,7 +454,8 @@ def main() -> None:
             out.write(json.dumps(
                 {"battery_start": stamp, "n_benches": len(todo)}) + "\n")
             for name, argv, timeout in todo:
-                n_ok += run_one(name, argv, timeout, out)
+                n_ok += run_one(name, argv, timeout, out,
+                                row_cap=args.row_timeout, hist=hist)
                 n_recs += 1
     finally:
         # same ADVICE item, the belt to the selection check's suspenders:

@@ -33,6 +33,14 @@ ROOT = Path(__file__).resolve().parents[1]
 # decode lever rows (round 11: int8 KV / Pallas decode-attend /
 # self-speculative vs the pinned-off continuity row), then the fused-CE /
 # overlap A/Bs.
+#
+# Round 21: with DTG_ONLINE_TUNE=1 the tune-sweep rows here are
+# REDUNDANT — first touch of an untuned key sweeps in situ inside
+# whichever row hits it (ops/autotune.ensure_tuned_online). They stay
+# anyway: the explicit sweeps run at full iteration counts under no
+# wall-clock budget, so their winners are the higher-confidence entries,
+# and the rows double as the online path's A/B (a table the online
+# tuner seeded should agree with the offline sweep).
 FIRST_WINDOW = [
     "flash_kernel_roofline",   # flash + decode_attend --tune sweeps
     "fused_ce_kernel",         # fused-CE chunk sweep

@@ -130,6 +130,13 @@ class ProgramContract:
     #: program is a finding, not a silent pass.
     quantized_matmuls: bool = False
 
+    #: Opt-in for fp8 contractions (round 21): the precision rule accepts
+    #: dot_generals with float8 operands — but ONLY e4m3fn, only into the
+    #: policy's accum dtype (preferred_element_type), and the result must
+    #: feed an f32 dequant mul (the same chain the int8 gate walks).
+    #: Default False: an fp8 dot in any other program is a finding.
+    fp8_matmuls: bool = False
+
 
 _REGISTRY: dict[str, ProgramContract] = {}
 

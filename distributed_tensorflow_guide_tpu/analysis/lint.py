@@ -21,6 +21,11 @@ derived cost vector is hashed (``analysis/fingerprint.py``) and compared
 to the blessed ``analysis/golden_fingerprints.json``; an unblessed
 change exits 1. ``--cost`` prints the per-program cost table;
 ``--bless --reason "why"`` rewrites the goldens.
+
+Round 21 adds ``--regress``: selftest the continuous regression gate
+(``analysis/regress.py``), then join the persisted bench history
+(``bench_history/history.jsonl``) against the cost model's roofline and
+exit 1 on unexplained measured/modeled ratio drift.
 """
 
 from __future__ import annotations
@@ -385,6 +390,15 @@ def main(argv=None) -> int:
                              "the golden file; required with --bless")
     parser.add_argument("--no-fingerprints", action="store_true",
                         help="skip the golden-fingerprint drift gate")
+    parser.add_argument("--regress", action="store_true",
+                        help="also gate the persisted bench history "
+                             "(bench_history/) against the cost model: "
+                             "selftest the gate, then flag rows whose "
+                             "measured/modeled ratio drifted past "
+                             "--regress-tol (analysis/regress.py)")
+    parser.add_argument("--regress-tol", type=float, default=None,
+                        help="drift tolerance for --regress (default "
+                             "0.25)")
     parser.add_argument("-v", "--verbose", action="store_true",
                         help="show per-rule observations for passing rules")
     args = parser.parse_args(argv)
@@ -423,14 +437,34 @@ def main(argv=None) -> int:
         return 0
     if not args.no_fingerprints:
         check_fingerprints(report, full_registry=full)
+    regress_ok = True
+    regress_out: dict | None = None
+    if args.regress:
+        from distributed_tensorflow_guide_tpu.analysis import regress
+
+        tol = (args.regress_tol if args.regress_tol is not None
+               else regress.DEFAULT_TOL)
+        st = regress.selftest(tol)
+        hist = regress.check_history(tol=tol)
+        regress_ok = bool(st["ok"]) and bool(hist["ok"])
+        regress_out = {"selftest_ok": st["ok"], **hist}
     if args.json:
-        print(json.dumps(report.to_dict()))
+        d = report.to_dict()
+        if regress_out is not None:
+            d["regress"] = regress_out
+        print(json.dumps(d))
     else:
         if args.cost:
             print(render_cost_table(report))
             print()
         print(render_text(report, verbose=args.verbose))
-    return 0 if report.ok else 1
+        if regress_out is not None:
+            from distributed_tensorflow_guide_tpu.analysis import regress
+
+            print(f"regress selftest: "
+                  f"{'PASS' if regress_out['selftest_ok'] else 'FAIL'}")
+            print(regress.render_report(regress_out))
+    return 0 if report.ok and regress_ok else 1
 
 
 if __name__ == "__main__":

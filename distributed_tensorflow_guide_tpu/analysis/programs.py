@@ -24,6 +24,7 @@ PROVIDER_MODULES = (
     "distributed_tensorflow_guide_tpu.parallel.pipeline",
     "distributed_tensorflow_guide_tpu.parallel.multislice",
     "distributed_tensorflow_guide_tpu.ops.fused_ce",
+    "distributed_tensorflow_guide_tpu.ops.quant",
     "distributed_tensorflow_guide_tpu.models.moe_lm",
     "distributed_tensorflow_guide_tpu.models.generation",
     "distributed_tensorflow_guide_tpu.serve.engine",

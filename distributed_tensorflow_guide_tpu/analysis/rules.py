@@ -168,7 +168,15 @@ def rule_precision(traced: TracedProgram,
     accum = jnp.dtype(policy.accum_dtype)
     observed: dict = {"policy": policy.name, "matmuls": 0,
                       "bad_operand_matmuls": 0, "bad_accum_ops": 0,
-                      "int_matmuls": 0}
+                      "int_matmuls": 0, "fp8_matmuls": 0}
+    # fp8 grids are jnp.floating subtypes, so without this carve-out every
+    # e4m3 dot would trip the compute-dtype check below. The gate mirrors
+    # the int8 one: contract.fp8_matmuls opts in, and opted-in dots must be
+    # e4m3-only (e5m2 is the gradient wire format, never a contraction
+    # operand here), accumulate at the policy's accum dtype via
+    # preferred_element_type, and feed an f32 dequant mul.
+    fp8_ok = jnp.dtype(jnp.float8_e4m3fn)
+    fp8_all = {fp8_ok, jnp.dtype(jnp.float8_e5m2)}
     findings = []
     eqns = list(walker.walk(traced.jaxpr))
     # var -> consuming eqns (vars are per-jaxpr objects, so identity keys
@@ -224,6 +232,44 @@ def rule_precision(traced: TracedProgram,
                 continue  # the float checks below don't apply to int dots
             op_dtypes = {jnp.dtype(v.aval.dtype) for v in eqn.invars
                          if jnp.issubdtype(v.aval.dtype, jnp.floating)}
+            fp8_dtypes = op_dtypes & fp8_all
+            if fp8_dtypes:
+                observed["fp8_matmuls"] += 1
+                if not contract.fp8_matmuls:
+                    findings.append(Finding(
+                        "precision",
+                        f"{name} with fp8 operands "
+                        f"{sorted(d.name for d in fp8_dtypes)} in a program "
+                        "whose contract does not opt in via fp8_matmuls",
+                        expected="policy-dtype operands (or "
+                                 "contract.fp8_matmuls=True)",
+                        observed=sorted(d.name for d in fp8_dtypes)))
+                else:
+                    if op_dtypes != {fp8_ok}:
+                        findings.append(Finding(
+                            "precision",
+                            f"fp8 {name} operands must all be "
+                            "float8_e4m3fn, got "
+                            f"{sorted(d.name for d in op_dtypes)}",
+                            expected="float8_e4m3fn",
+                            observed=sorted(d.name for d in op_dtypes)))
+                    out = jnp.dtype(eqn.outvars[0].aval.dtype)
+                    if out != accum:
+                        findings.append(Finding(
+                            "precision",
+                            f"fp8 {name} accumulates in {out.name} — fp8 "
+                            "contractions must widen to the accum dtype "
+                            "(set preferred_element_type)",
+                            expected=accum.name, observed=out.name))
+                    elif not _int_dot_dequant_ok(eqn, consumers):
+                        findings.append(Finding(
+                            "precision",
+                            f"fp8 {name} result is never rescaled by an "
+                            "f32 scale (no dequant mul found on its "
+                            "accumulator)",
+                            expected="acc * f32_scale",
+                            observed="no f32 mul in the consumer chain"))
+                continue  # the policy-dtype checks below don't apply
             bad = op_dtypes - {compute}
             if bad:
                 observed["bad_operand_matmuls"] += 1

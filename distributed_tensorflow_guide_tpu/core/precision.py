@@ -55,23 +55,34 @@ class Policy:
     # Params stay f32 masters (param_dtype), the head/loss stays
     # accum_dtype — only the MXU-bound dots change representation.
     quantized_matmuls: bool = False
+    # fp8 matmuls (ops/quant.fp8_ste_dot, round 21): same STE discipline
+    # as int8 — per-tensor dynamic scales, f32 accumulation, straight-
+    # through backward — with e4m3 operands instead of int8. Only the
+    # MXU-native mode on fp8-capable TPU generations; see require_fp8.
+    fp8_matmuls: bool = False
 
     def __post_init__(self):
         if self.remat not in REMAT_MODES:
             raise ValueError(
                 f"remat must be one of {REMAT_MODES}, got {self.remat!r}")
+        if self.quantized_matmuls and self.fp8_matmuls:
+            raise ValueError(
+                "quantized_matmuls and fp8_matmuls are exclusive — one "
+                "quantized representation per policy")
 
     def apply_to_transformer(self, cfg):
         """A TransformerConfig re-expressed under this policy: activation
         dtype = compute_dtype, remat mode threaded through ``remat_mode``
-        (with the legacy bool kept consistent for old call sites), int8
-        training matmuls through ``quantized_matmuls``."""
+        (with the legacy bool kept consistent for old call sites), int8 /
+        fp8 training matmuls through ``quantized_matmuls`` /
+        ``fp8_matmuls``."""
         import dataclasses as _dc
 
         return _dc.replace(
             cfg, dtype=self.compute_dtype,
             remat=self.remat == "block", remat_mode=self.remat,
-            quantized_matmuls=self.quantized_matmuls)
+            quantized_matmuls=self.quantized_matmuls,
+            fp8_matmuls=self.fp8_matmuls)
 
 
 PRESETS: dict[str, Policy] = {
@@ -91,6 +102,13 @@ PRESETS: dict[str, Policy] = {
     # preset against "f32" step-for-step.
     "int8": Policy("int8", compute_dtype=jnp.float32,
                    quantized_matmuls=True),
+    # fp8 training matmuls (round 21): the same isolation discipline as
+    # "int8" — f32 masters, f32 non-matmul compute, so the only delta vs
+    # "f32" is the e4m3 contraction — with the fp8_ste_dot quantizer.
+    # Gate with require_fp8() before building device programs: pre-fp8
+    # TPU generations silently emulate e4m3 through f32 convert pairs,
+    # which costs MORE than bf16 while looking like a win.
+    "fp8": Policy("fp8", compute_dtype=jnp.float32, fp8_matmuls=True),
 }
 
 
@@ -106,3 +124,56 @@ def resolve(policy, default: str = "bf16") -> Policy:
         raise ValueError(
             f"unknown precision policy {policy!r} "
             f"(presets: {sorted(PRESETS)})") from None
+
+
+# --------------------------------------------------------------------------
+# fp8 capability gate (round 21)
+# --------------------------------------------------------------------------
+
+#: device_kind substrings of TPU generations with native fp8 MXU modes.
+#: Older generations (and CPU/GPU backends this repo doesn't model) still
+#: EXECUTE e4m3 programs — XLA legalizes through f32 convert pairs — but
+#: that emulation reads the same bytes as f32 and burns extra converts, so
+#: "runs" must not be confused with "capable". Matched case-insensitively
+#: against ``jax.devices()[0].device_kind``.
+FP8_DEVICE_KINDS = ("v6", "v7", "trillium")
+
+#: Escape hatch: set to force fp8_capable() True — for numerics work on
+#: CPU/older hardware where the (slow) emulated semantics are the point.
+FP8_EMULATE_ENV = "DTG_FP8_EMULATE"
+
+
+def fp8_capable(device_kind: str | None = None) -> bool:
+    """Whether ``device_kind`` (default: this process's device 0) has a
+    native fp8 MXU mode. With :data:`FP8_EMULATE_ENV` set truthy, always
+    True — the explicit "I want the emulation" override."""
+    import os
+
+    raw = os.environ.get(FP8_EMULATE_ENV, "").strip().lower()
+    if raw not in ("", "0", "false", "no", "off"):
+        return True
+    if device_kind is None:
+        import jax
+
+        device_kind = jax.devices()[0].device_kind
+    kind = device_kind.lower()
+    return any(s in kind for s in FP8_DEVICE_KINDS)
+
+
+def require_fp8(device_kind: str | None = None) -> None:
+    """Fail fast (ValueError) when fp8 is requested on a device generation
+    without native fp8 matmuls — emulated fp8 is a net loss there, so
+    silently proceeding would invert the point of the preset."""
+    if fp8_capable(device_kind):
+        return
+    if device_kind is None:
+        import jax
+
+        device_kind = jax.devices()[0].device_kind
+    raise ValueError(
+        f"fp8 requested but device_kind {device_kind!r} has no native fp8 "
+        f"matmul mode (capable kinds match {FP8_DEVICE_KINDS}). XLA would "
+        "emulate e4m3 through f32 converts — same HBM bytes as f32 plus "
+        "extra convert work, strictly worse than bf16. Use precision "
+        "'bf16'/'int8' (or weight_dtype='int8' for decode) here, or set "
+        f"{FP8_EMULATE_ENV}=1 to force the emulation for numerics work.")

@@ -148,6 +148,14 @@ class TransformerConfig:
     # the classifier keep full-precision accumulation. Training-side only
     # — decode uses ``weight_dtype``.
     quantized_matmuls: bool = False
+    # fp8 TRAINING matmuls (core/precision.py PRESETS["fp8"], round 21):
+    # the projection contractions cast both operands to e4m3 with
+    # per-tensor dynamic scales and accumulate in f32, backward straight-
+    # through (ops/quant.fp8_ste_dot) — the same tree-transparent
+    # QuantTrainDense shape as quantized_matmuls, so loss-parity pins
+    # transfer. Gate with core.precision.require_fp8(): pre-fp8 TPU
+    # generations emulate e4m3 at a net loss.
+    fp8_matmuls: bool = False
 
     def __post_init__(self):
         if self.attn_impl not in ("auto", "dense", "flash"):
@@ -195,9 +203,9 @@ class TransformerConfig:
                     f"(got {self.lora_adapters})")
         elif self.lora_adapters:
             raise ValueError("lora_adapters requires lora_rank")
-        if self.weight_dtype not in (None, "int8", "int4"):
+        if self.weight_dtype not in (None, "int8", "int4", "fp8"):
             raise ValueError(
-                "weight_dtype must be None, 'int8' or 'int4', "
+                "weight_dtype must be None, 'int8', 'int4' or 'fp8', "
                 f"got {self.weight_dtype!r}"
             )
         if self.weight_dtype is not None:
@@ -209,22 +217,33 @@ class TransformerConfig:
                     "weight_dtype (decode-side) and quantized_matmuls "
                     "(training-side) are mutually exclusive"
                 )
+            if self.fp8_matmuls:
+                raise ValueError(
+                    "weight_dtype (decode-side) and fp8_matmuls "
+                    "(training-side) are mutually exclusive"
+                )
             if self.lora_rank is not None:
                 raise ValueError(
                     "weight_dtype and lora_rank are mutually exclusive "
                     "(the quantized projections have no f32 kernel for "
                     "the deltas to ride on)"
                 )
-        if self.quantized_matmuls:
+        if self.quantized_matmuls or self.fp8_matmuls:
+            lever = ("quantized_matmuls" if self.quantized_matmuls
+                     else "fp8_matmuls")
+            if self.quantized_matmuls and self.fp8_matmuls:
+                raise ValueError(
+                    "quantized_matmuls and fp8_matmuls are mutually "
+                    "exclusive — one quantized representation per model"
+                )
             if self.decode:
                 raise ValueError(
-                    "quantized_matmuls is the training lever; decode-side "
+                    f"{lever} is the training lever; decode-side "
                     "quantization is weight_dtype"
                 )
             if self.lora_rank is not None:
                 raise ValueError(
-                    "quantized_matmuls and lora_rank are mutually "
-                    "exclusive"
+                    f"{lever} and lora_rank are mutually exclusive"
                 )
 
     @property
@@ -346,7 +365,7 @@ def _lora_delta(a, b, x: jax.Array, adapter: jax.Array) -> jax.Array:
     return jnp.einsum("bcr,bre->bce", t, b_e)
 
 
-_WQ_BITS = {"int8": 8, "int4": 4}
+_WQ_BITS = {"int8": 8, "int4": 4, "fp8": "fp8"}
 
 
 def _prod(dims) -> int:
@@ -360,7 +379,8 @@ class WeightQuantDense(nn.Module):
     """Weight-only quantized projection (``cfg.weight_dtype``, decode).
 
     Declares the serving-side param layout directly — ``qkernel`` (int8,
-    or int4 packed two-per-byte into uint8) plus per-output-column f32
+    int4 packed two-per-byte into uint8, or fp8-e4m3) plus
+    per-output-column f32
     ``scale`` — exactly what ``ops.quant.quantize_params`` produces from
     the f32 sibling's ``kernel``, under the SAME module name, so the
     quantized tree drops straight into ``model.apply``. The dequant is
@@ -373,7 +393,7 @@ class WeightQuantDense(nn.Module):
 
     features: tuple
     in_axes: int = 1
-    bits: int = 8
+    bits: Any = 8  # 8 | 4 | "fp8"
     dtype: Dtype = jnp.float32
     use_bias: bool = False
 
@@ -387,6 +407,8 @@ class WeightQuantDense(nn.Module):
                 raise ValueError(
                     f"int4 packing needs an even fan-in, got {d_in}")
             rows, store = d_in // 2, jnp.uint8
+        elif self.bits == "fp8":
+            rows, store = d_in, jnp.float8_e4m3fn
         else:
             rows, store = d_in, jnp.int8
         qkernel = self.param("qkernel", nn.initializers.zeros_init(),
@@ -406,16 +428,18 @@ class WeightQuantDense(nn.Module):
 
 
 class QuantTrainDense(nn.Module):
-    """AQT-style int8 training projection (``cfg.quantized_matmuls``).
+    """AQT-style quantized training projection (``cfg.quantized_matmuls``
+    for int8, ``cfg.fp8_matmuls`` for e4m3 via ``mode="fp8"``).
 
     Param-tree transparent: declares the SAME ``kernel`` (and optional
     ``bias``) — names, shapes, f32 param dtype, initializers — as the
     ``nn.Dense``/``nn.DenseGeneral`` it replaces, and flax derives init
     RNG from the param path, so the init draws are bit-identical to the
     unquantized model (the basis of the loss-parity pins). Only the
-    contraction changes: ``ops.quant.int8_ste_dot`` quantizes both
-    operands per-tensor dynamically each step, accumulates int8 x int8 in
-    int32, rescales in f32, and backpropagates straight-through.
+    contraction changes: ``ops.quant.int8_ste_dot`` (or ``fp8_ste_dot``)
+    quantizes both operands per-tensor dynamically each step, accumulates
+    int8 x int8 in int32 (e4m3 x e4m3 in f32 for fp8), rescales in f32,
+    and backpropagates straight-through.
     """
 
     features: tuple
@@ -424,6 +448,7 @@ class QuantTrainDense(nn.Module):
     kernel_init: Any = None
     use_bias: bool = False
     bias_init: Any = None
+    mode: str = "int8"  # "int8" | "fp8"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -434,9 +459,10 @@ class QuantTrainDense(nn.Module):
                             jnp.float32)
         from distributed_tensorflow_guide_tpu.ops import quant
 
+        dot = quant.fp8_ste_dot if self.mode == "fp8" else quant.int8_ste_dot
         xf = x.reshape(x.shape[:-self.in_axes] + (d_in,)).astype(self.dtype)
         k2d = kernel.astype(self.dtype).reshape(d_in, -1)
-        y = quant.int8_ste_dot(xf, k2d).astype(self.dtype)
+        y = dot(xf, k2d).astype(self.dtype)
         if self.use_bias:
             bias = self.param("bias", self.bias_init, feats, jnp.float32)
             y = y + bias.reshape(-1).astype(self.dtype)
@@ -458,10 +484,11 @@ class MultiHeadAttention(nn.Module):
                 (3, h, hd), in_axes=1, bits=_WQ_BITS[cfg.weight_dtype],
                 dtype=cfg.dtype, name="qkv",
             )(x)
-        elif cfg.quantized_matmuls:
+        elif cfg.quantized_matmuls or cfg.fp8_matmuls:
             qkv = QuantTrainDense(
                 (3, h, hd), in_axes=1, dtype=cfg.dtype,
                 kernel_init=_dense_init("embed", "qkv", "heads", "kv"),
+                mode="fp8" if cfg.fp8_matmuls else "int8",
                 name="qkv",
             )(x)
         else:
@@ -521,10 +548,11 @@ class MultiHeadAttention(nn.Module):
                 (cfg.d_model,), in_axes=2, bits=_WQ_BITS[cfg.weight_dtype],
                 dtype=cfg.dtype, name="proj",
             )(out)
-        elif cfg.quantized_matmuls:
+        elif cfg.quantized_matmuls or cfg.fp8_matmuls:
             out = QuantTrainDense(
                 (cfg.d_model,), in_axes=2, dtype=cfg.dtype,
                 kernel_init=_dense_init("heads", "kv", "embed"),
+                mode="fp8" if cfg.fp8_matmuls else "int8",
                 name="proj",
             )(out)
         else:
@@ -827,7 +855,7 @@ class MLP(nn.Module):
                 (cfg.d_ff,), in_axes=1, bits=_WQ_BITS[cfg.weight_dtype],
                 dtype=cfg.dtype, use_bias=True, name="up",
             )(x)
-        elif cfg.quantized_matmuls:
+        elif cfg.quantized_matmuls or cfg.fp8_matmuls:
             y = QuantTrainDense(
                 (cfg.d_ff,), in_axes=1, dtype=cfg.dtype,
                 kernel_init=_dense_init("embed", "mlp"),
@@ -835,6 +863,7 @@ class MLP(nn.Module):
                 bias_init=nn.with_logical_partitioning(
                     nn.initializers.zeros_init(), ("mlp",)
                 ),
+                mode="fp8" if cfg.fp8_matmuls else "int8",
                 name="up",
             )(x)
         else:
@@ -860,10 +889,11 @@ class MLP(nn.Module):
                 (cfg.d_model,), in_axes=1, bits=_WQ_BITS[cfg.weight_dtype],
                 dtype=cfg.dtype, name="down",
             )(y)
-        elif cfg.quantized_matmuls:
+        elif cfg.quantized_matmuls or cfg.fp8_matmuls:
             y = QuantTrainDense(
                 (cfg.d_model,), in_axes=1, dtype=cfg.dtype,
                 kernel_init=_dense_init("mlp", "embed"),
+                mode="fp8" if cfg.fp8_matmuls else "int8",
                 name="down",
             )(y)
         else:

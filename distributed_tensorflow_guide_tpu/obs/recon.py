@@ -85,6 +85,16 @@ def reconcile(cost, measured_s: float, roof: Roofline) -> dict:
     if roof.peak_ici_bytes_s:
         times["comm"] = coll / roof.peak_ici_bytes_s
         ici_frac = coll / measured_s / roof.peak_ici_bytes_s
+    # pcie term (round 21, additive): only when the cost dict carries
+    # swap/offload bytes AND the roofline has a pcie peak — absent either,
+    # the output dict is unchanged key-for-key from the round-20 shape.
+    pcie_frac = None
+    pcie_bytes = (float(cost.get("pcie_bytes", 0.0) or 0.0)
+                  if isinstance(cost, dict)
+                  else float(getattr(cost, "pcie_bytes", 0.0) or 0.0))
+    if roof.peak_pcie_bytes_s and pcie_bytes:
+        times["pcie"] = pcie_bytes / roof.peak_pcie_bytes_s
+        pcie_frac = pcie_bytes / measured_s / roof.peak_pcie_bytes_s
     model_time_s = max(times.values())
     bound = max(times, key=lambda k: times[k])
     out = {
@@ -99,4 +109,6 @@ def reconcile(cost, measured_s: float, roof: Roofline) -> dict:
         "efficiency": model_time_s / measured_s,
         "bound": bound,
     }
+    if pcie_frac is not None:
+        out["pcie_frac"] = pcie_frac
     return out

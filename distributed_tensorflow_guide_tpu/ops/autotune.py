@@ -183,11 +183,15 @@ def _key(kernel: str, b: int, h: int, s: int, d: int, dtype: str,
 
 
 def reset() -> None:
-    """Drop the in-memory table (tests; the next TPU lookup reloads)."""
-    global _loaded_from
+    """Drop the in-memory table AND the online-tune session state (tests;
+    the next TPU lookup reloads)."""
+    global _loaded_from, _online_override, _online_spent_s
     with _lock:
         _mem.clear()
         _loaded_from = None
+        _online_override = None
+        _online_attempted.clear()
+        _online_spent_s = 0.0
 
 
 def _maybe_load(platform: str) -> None:
@@ -837,3 +841,181 @@ def ensure_tuned(kernel: str, *, b: int, h: int, s: int, d: int, dtype,
     record(kernel, b=b, h=h, s=s, d=d, dtype=dtype, blocks=best,
            detail=detail, causal=causal, platform=plat)
     return best
+
+
+# --------------------------------------------------------------------------
+# online in-situ tuning (round 21)
+# --------------------------------------------------------------------------
+#
+# The offline story (bench --tune on a captured window, table persisted to
+# the cache dir) leaves every UNSEEN key — new device kind, new geometry —
+# on the tested defaults until someone runs a sweep by hand. The online
+# front door closes that gap: when a call site resolves a key that has no
+# table entry on a sweep-capable backend, it runs the existing ensure_*
+# sweep IN SITU (first trace/warmup pays it once), records the winner
+# through the same crash-safe tmp+rename persistence, and every later
+# resolution of the key — this process or the next — is a plain lookup
+# hit. Three hard bounds keep it safe:
+#
+# * **default-off**: nothing sweeps unless ``DTG_ONLINE_TUNE`` is truthy
+#   or a knob (``ServeEngine(online_tune=True)``,
+#   ``TrainLoop(online_tune=True)``) set the process override;
+# * **CPU-hermetic**: on the cpu platform the front door is bitwise the
+#   fallback path — no table I/O, no sweeps (the PR-2 contract, re-pinned
+#   by tests/test_online_tune.py);
+# * **bounded wall-clock**: sweeps stop once the per-process budget
+#   (``DTG_ONLINE_TUNE_BUDGET_S``, default 120 s) is spent, and every key
+#   is attempted at most ONCE per process even when its sweep fails —
+#   a key that cannot tune falls back to defaults forever, it never
+#   retries in a serving loop.
+
+_ONLINE_ENV = "DTG_ONLINE_TUNE"
+_ONLINE_BUDGET_ENV = "DTG_ONLINE_TUNE_BUDGET_S"
+DEFAULT_ONLINE_BUDGET_S = 120.0
+
+_online_override: bool | None = None
+_online_attempted: set = set()
+_online_spent_s: float = 0.0
+
+
+def set_online_tune(enabled: bool | None) -> bool | None:
+    """Set (or with ``None`` clear) the process-wide online-tune override.
+    The override wins over ``DTG_ONLINE_TUNE``; returns the previous
+    override so callers can restore it. This is deliberately process
+    state, like the table itself — an engine that opts in tunes for
+    every consumer of the shared table."""
+    global _online_override
+    with _lock:
+        prev = _online_override
+        _online_override = None if enabled is None else bool(enabled)
+    return prev
+
+
+def online_tune_enabled() -> bool:
+    """Whether the online front door may sweep: the explicit override
+    when one is set, else the ``DTG_ONLINE_TUNE`` env gate (truthy =
+    anything but empty/0/false/no)."""
+    if _online_override is not None:
+        return _online_override
+    raw = os.environ.get(_ONLINE_ENV, "").strip().lower()
+    return raw not in ("", "0", "false", "no", "off")
+
+
+def online_tune_budget_s() -> float:
+    """Per-process wall-clock budget for in-situ sweeps
+    (``DTG_ONLINE_TUNE_BUDGET_S``, default 120 s)."""
+    raw = os.environ.get(_ONLINE_BUDGET_ENV, "")
+    try:
+        return float(raw) if raw else DEFAULT_ONLINE_BUDGET_S
+    except ValueError:
+        return DEFAULT_ONLINE_BUDGET_S
+
+
+def online_tune_stats() -> dict:
+    """Observability snapshot: what the online tuner has done this
+    process (benchmarks log it next to their tune rows)."""
+    with _lock:
+        return {
+            "enabled": online_tune_enabled(),
+            "attempted": len(_online_attempted),
+            "spent_s": round(_online_spent_s, 3),
+            "budget_s": online_tune_budget_s(),
+        }
+
+
+def ensure_tuned_online(kernel: str, *, measure: Callable | None = None,
+                        iters: int = 20, block_size: int | None = None,
+                        fallback: Callable[[], object] | None = None,
+                        platform: str | None = None, **key):
+    """The ONE online resolution path every tuned family routes through.
+
+    ``kernel`` picks the family — flash fwd/dq/dkv/carry and the two
+    decode kernels (key fields ``b, h, s, d, dtype, causal``; returns the
+    family's resolved value: a blocks tuple for the training kernels, the
+    KV edge int for decode/paged), :data:`CE_KERNEL` (``n, d, v, dtype``;
+    returns the chunk) and :data:`BUCKET_KERNEL` (``param_bytes, world,
+    dtype``; returns the bucket bytes). ``fallback`` is the zero-arg
+    trace-safe default the caller would have used — REQUIRED for the
+    decode kernels (their divisibility cascades live with the kernel),
+    derived from the family ``*_for`` otherwise. It must never loop back
+    into this function.
+
+    No-sweep exits return ``fallback()`` exactly: online tuning disabled,
+    cpu platform (hermeticity — not even a table read happens beyond what
+    the fallback itself does), lookup hit (the fallback IS the hit), key
+    already attempted, budget spent, sweep raised, or a bucket key with
+    no measure (the bucket family has no self-contained runner — only
+    callers that can time a real train step may sweep it)."""
+    import time
+
+    plat_arg = platform
+
+    def _default():
+        if fallback is not None:
+            return fallback()
+        if kernel == CE_KERNEL:
+            return ce_chunk_for(platform=plat_arg, **key)
+        if kernel == BUCKET_KERNEL:
+            return bucket_bytes_for(platform=plat_arg, **key)
+        if kernel in (DECODE_KERNEL, PAGED_DECODE_KERNEL):
+            raise ValueError(
+                f"{kernel} requires an explicit fallback (the divisibility "
+                "cascade lives in ops/decode_attention.py)")
+        return blocks_for(kernel, platform=plat_arg, **key)
+
+    if not online_tune_enabled():
+        return _default()
+    plat = _platform(platform)
+    if plat == "cpu":
+        return _default()  # hermetic: bitwise the fallback path
+    if kernel == BUCKET_KERNEL and measure is None:
+        return _default()
+
+    # lookup hit -> the fallback already resolves to the tuned entry
+    if kernel == CE_KERNEL:
+        hit = ce_chunk_lookup(platform=plat, **key)
+    elif kernel == BUCKET_KERNEL:
+        hit = bucket_lookup(platform=plat, **key)
+    else:
+        hit = lookup(kernel, platform=plat, **key)
+    if hit is not None:
+        return _default()
+
+    akey = (kernel, plat,
+            tuple(sorted((k, repr(v)) for k, v in key.items())))
+    global _online_spent_s
+    with _lock:
+        # decide under the lock, resolve outside it: _default() may walk
+        # back into table lookups that take this same (non-reentrant) lock
+        blocked = (akey in _online_attempted
+                   or _online_spent_s >= online_tune_budget_s())
+        if not blocked:
+            _online_attempted.add(akey)  # at most one attempt, even on fail
+    if blocked:
+        return _default()
+
+    t0 = time.perf_counter()
+    try:
+        if kernel == CE_KERNEL:
+            return ensure_ce_tuned(iters=iters, measure=measure,
+                                   platform=plat, **key)
+        if kernel == BUCKET_KERNEL:
+            return ensure_bucket_tuned(measure=measure, platform=plat,
+                                       **key)
+        if kernel == PAGED_DECODE_KERNEL:
+            from distributed_tensorflow_guide_tpu.ops import decode_attention
+            kw = {k: v for k, v in key.items() if k != "causal"}
+            return decode_attention.ensure_paged_decode_tuned(
+                block_size=block_size, iters=iters, platform=plat, **kw)
+        if kernel == DECODE_KERNEL:
+            from distributed_tensorflow_guide_tpu.ops import decode_attention
+            kw = {k: v for k, v in key.items() if k != "causal"}
+            return decode_attention.ensure_decode_tuned(
+                iters=iters, platform=plat, **kw)
+        return ensure_tuned(kernel, iters=iters, measure=measure,
+                            platform=plat, **key)
+    except Exception:  # noqa: BLE001 - a failed sweep must not fail serving
+        return _default()
+    finally:
+        with _lock:
+            _online_spent_s += time.perf_counter() - t0

@@ -96,16 +96,15 @@ def decode_blk_k_for(*, b: int, h: int, s: int, d: int, dtype,
                      platform: str | None = None) -> int:
     """The KV block edge a decode call site should use: the tuned table
     entry when one exists (key: s = max_len, dtype = CACHE dtype,
-    causal=False), else the tested default clipped by divisibility. Never
-    sweeps, never writes — safe at trace time on any platform."""
+    causal=False), else the ``_default_blk_k`` cascade via the online
+    front door (``ensure_tuned_online``: trace-safe; default no-op)."""
     hit = autotune.lookup(DECODE_KERNEL, b=b, h=h, s=s, d=d, dtype=dtype,
                           causal=False, platform=platform)
     if hit is not None:
         return hit[1]
-    for cand in (DEFAULT_DECODE_BLK_K, 128, 64, 32, 16, 8):
-        if cand <= s and s % cand == 0:
-            return cand
-    return s
+    return autotune.ensure_tuned_online(
+        DECODE_KERNEL, b=b, h=h, s=s, d=d, dtype=dtype, causal=False,
+        platform=platform, fallback=lambda: _default_blk_k(s))
 
 
 def ensure_decode_tuned(*, b: int, h: int, s: int, d: int, dtype,
@@ -384,16 +383,17 @@ def paged_decode_blk_k_for(*, b: int, h: int, s: int, d: int, dtype,
                            platform: str | None = None) -> int:
     """KV edge for the paged kernel: the ``decode_paged`` table entry when
     one exists AND divides the pool block size, else the largest tested
-    default that does. Key: s = max_len (the logical view the grid spans),
-    dtype = the CACHE dtype."""
+    default that does (``_default_blk_k(block_size)``, via the online
+    front door; a non-dividing stale result is re-clipped to it)."""
     hit = autotune.lookup(PAGED_DECODE_KERNEL, b=b, h=h, s=s, d=d,
                           dtype=dtype, causal=False, platform=platform)
     if hit is not None and block_size % hit[1] == 0:
         return hit[1]
-    for cand in (DEFAULT_DECODE_BLK_K, 128, 64, 32, 16, 8):
-        if cand <= block_size and block_size % cand == 0:
-            return cand
-    return block_size
+    blk = autotune.ensure_tuned_online(
+        PAGED_DECODE_KERNEL, b=b, h=h, s=s, d=d, dtype=dtype, causal=False,
+        block_size=block_size, platform=platform,
+        fallback=lambda: _default_blk_k(block_size))
+    return blk if block_size % blk == 0 else _default_blk_k(block_size)
 
 
 def ensure_paged_decode_tuned(*, b: int, h: int, s: int, d: int, dtype,
@@ -665,3 +665,16 @@ def _register_kernel_costs():
 
 
 _register_kernel_costs()
+
+
+def _default_blk_k(s: int) -> int:
+    """The tested-default cascade: the largest default edge that divides
+    ``s`` (the cache length, or the pool block size on the paged path).
+    Sweep-free and lookup-free — the online front door's fallback must
+    never re-enter the resolution path. Defined BELOW the pallas kernels
+    on purpose: jaxpr fingerprints embed kernel source line numbers, so
+    resolution-layer code must not shift them."""
+    for cand in (DEFAULT_DECODE_BLK_K, 128, 64, 32, 16, 8):
+        if cand <= s and s % cand == 0:
+            return cand
+    return s

@@ -691,12 +691,16 @@ def flash_blocks(b: int, h: int, s: int, d: int, dtype,
 
     With :func:`carry_blocks` and :func:`bwd_blocks`, these helpers are
     the ONLY lookup paths — key construction (logical head dim, dtype,
-    causal regime) lives here, never at call sites."""
+    causal regime) lives here, never at call sites. Resolution routes
+    through ``autotune.ensure_tuned_online``: with online tuning OFF
+    (the default) that is exactly the old trace-safe ``blocks_for``
+    lookup; with it ON, an unseen key on a sweep-capable backend pays
+    one in-situ sweep here (first trace) and persists the winner."""
     kw = dict(b=b, h=h, s=s, d=d, dtype=dtype, causal=causal)
     return FlashBlocks(
-        fwd=autotune.blocks_for("flash_fwd", **kw),
-        dq=autotune.blocks_for("flash_dq", **kw),
-        dkv=autotune.blocks_for("flash_dkv", **kw),
+        fwd=autotune.ensure_tuned_online("flash_fwd", **kw),
+        dq=autotune.ensure_tuned_online("flash_dq", **kw),
+        dkv=autotune.ensure_tuned_online("flash_dkv", **kw),
     )
 
 
@@ -706,8 +710,8 @@ def bwd_blocks(b: int, h: int, s: int, d: int, dtype,
     """(blk_dq, blk_dkv) for a standalone backward call — what the ring's
     hand-written per-visit backward (parallel/sequence.py) resolves."""
     kw = dict(b=b, h=h, s=s, d=d, dtype=dtype, causal=causal)
-    return (autotune.blocks_for("flash_dq", **kw),
-            autotune.blocks_for("flash_dkv", **kw))
+    return (autotune.ensure_tuned_online("flash_dq", **kw),
+            autotune.ensure_tuned_online("flash_dkv", **kw))
 
 
 def carry_blocks(b: int, h: int, s: int, d: int, dtype,
@@ -715,8 +719,8 @@ def carry_blocks(b: int, h: int, s: int, d: int, dtype,
     """Tuned blocks for the ring carry kernel, keyed on the LOGICAL head
     dim (the ring call sites know it; flash_carry_step itself only sees the
     padded dim)."""
-    return autotune.blocks_for("carry_step", b=b, h=h, s=s, d=d,
-                               dtype=dtype, causal=causal)
+    return autotune.ensure_tuned_online("carry_step", b=b, h=h, s=s, d=d,
+                                        dtype=dtype, causal=causal)
 
 
 def supported(s: int, d: int, blk_q: int | None = None,

@@ -223,7 +223,13 @@ def fused_cross_entropy(x, kernel, targets, *, chunk: int | None = None,
     t1 = targets.reshape(-1)
     v_local = kernel.shape[1]
     if chunk is None:
-        chunk = ce_chunk_for(n=x2.shape[0], d=d, v=v_local, dtype=x.dtype)
+        from distributed_tensorflow_guide_tpu.ops import autotune
+
+        chunk = autotune.ensure_tuned_online(
+            autotune.CE_KERNEL, n=x2.shape[0], d=d, v=v_local,
+            dtype=x.dtype,
+            fallback=lambda: ce_chunk_for(n=x2.shape[0], d=d, v=v_local,
+                                          dtype=x.dtype))
     chunk = max(1, min(int(chunk), v_local))
     total = _fused_nll(chunk, axis)(x2, kernel, t1)
     if reduction == "sum":

@@ -52,6 +52,8 @@ from jax import lax
 
 __all__ = [
     "QMAX",
+    "FP8_DTYPE",
+    "FP8_MAX",
     "quantize_channelwise",
     "dequantize_channelwise",
     "pack_int4",
@@ -60,6 +62,7 @@ __all__ = [
     "quantize_params",
     "WQ_PROJECTIONS",
     "int8_ste_dot",
+    "fp8_ste_dot",
     "int8_pmean",
 ]
 
@@ -67,24 +70,44 @@ __all__ = [
 #: convention — -128 stays unused so the grid is symmetric), int4 at +-7.
 QMAX = {8: 127, 4: 7}
 
+#: The fp8 storage/compute format (round 21): e4m3 — the inference/forward
+#: format of the fp8 literature (e5m2 trades mantissa for exponent range
+#: the per-tensor scale already provides). Scales map amax onto the max
+#: FINITE e4m3fn value; the cast saturates, so nothing can land on NaN.
+FP8_DTYPE = jnp.float8_e4m3fn
+FP8_MAX = 448.0
 
-def _check_bits(bits: int) -> int:
+#: ``bits`` spellings the weight-only path accepts: the integer grids plus
+#: the "fp8" byte format (same per-output-channel scale contract; the
+#: grid is the e4m3 float lattice instead of a symmetric integer ladder).
+WQ_BITS_VALUES = (8, 4, "fp8")
+
+
+def _check_bits(bits) -> float:
+    if bits == "fp8":
+        return FP8_MAX
     if bits not in QMAX:
-        raise ValueError(f"bits must be one of {sorted(QMAX)}, got {bits}")
+        raise ValueError(
+            f"bits must be one of {sorted(QMAX)} or 'fp8', got {bits!r}")
     return QMAX[bits]
 
 
-def quantize_channelwise(w, bits: int = 8):
+def quantize_channelwise(w, bits=8):
     """Per-output-channel symmetric quantization of a 2-D ``(d_in, d_out)``
     kernel: ``(int8 values, f32 scale (d_out,))``. Same contract as
     ``ops.decode_attention.quantize_kv``: one scale per output column
     (amax over the contracted d_in axis), an all-zero column maps to
     scale 1 (not 0) so dequant is always exact-zero, and round-to-nearest
-    keeps the error per element <= scale/2."""
+    keeps the error per element <= scale/2. ``bits="fp8"`` stores e4m3
+    values on the same scale contract (amax maps to the max finite e4m3,
+    448): the error per element is RELATIVE (~2^-3 of magnitude, the
+    3-bit mantissa) rather than the integer grids' absolute scale/2."""
     qmax = _check_bits(bits)
     wf = w.astype(jnp.float32)
     amax = jnp.max(jnp.abs(wf), axis=0)  # (d_out,)
     scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    if bits == "fp8":
+        return (wf / scale[None, :]).astype(FP8_DTYPE), scale
     values = jnp.clip(jnp.round(wf / scale[None, :]), -qmax, qmax)
     return values.astype(jnp.int8), scale
 
@@ -121,18 +144,20 @@ def unpack_int4(packed):
     return inter.reshape((2 * packed.shape[0],) + packed.shape[1:])
 
 
-def wq_matmul(x, qkernel, scale, *, bits: int = 8, dtype=jnp.float32):
+def wq_matmul(x, qkernel, scale, *, bits=8, dtype=jnp.float32):
     """``x @ dequant(qkernel)`` with the dequant FUSED into the matmul.
 
     ``x`` is ``(..., d_in)`` at the activation dtype, ``qkernel`` the
-    stored int8 (or int4-packed uint8) ``(d_in[, /2], d_out)`` kernel,
-    ``scale`` the per-output-column f32 scales. The int cast rides the
-    contraction (XLA folds the convert into the matmul read — the HBM
-    bytes that cross the wire are the stored dtype's, which is what the
-    cost auditor charges) and the scale multiplies the OUTPUT columns:
-    scale is constant along the contracted axis, so
-    ``(x @ q) * s == x @ (q * s)`` exactly — the dequantized kernel copy
-    is never materialized."""
+    stored int8 (or int4-packed uint8, or fp8-e4m3) ``(d_in[, /2],
+    d_out)`` kernel, ``scale`` the per-output-column f32 scales. The
+    stored-dtype cast rides the contraction (XLA folds the convert into
+    the matmul read — the HBM bytes that cross the wire are the stored
+    dtype's, which is what the cost auditor charges) and the scale
+    multiplies the OUTPUT columns: scale is constant along the contracted
+    axis, so ``(x @ q) * s == x @ (q * s)`` exactly — the dequantized
+    kernel copy is never materialized. fp8 follows the identical shape:
+    the e4m3 byte is the storage format, the contraction runs at the
+    activation dtype after the (free) widening cast."""
     _check_bits(bits)
     w = unpack_int4(qkernel) if bits == 4 else qkernel
     y = lax.dot_general(
@@ -152,7 +177,7 @@ def _unbox(leaf):
     return leaf.unbox() if hasattr(leaf, "unbox") else leaf
 
 
-def quantize_params(params, *, bits: int = 8,
+def quantize_params(params, *, bits=8,
                     projections: dict | None = None):
     """The serving-side tree transform: an f32 ``Transformer`` param tree
     re-expressed for ``TransformerConfig.weight_dtype``. Every projection
@@ -244,6 +269,46 @@ int8_ste_dot.defvjp(_int8_ste_fwd, _int8_ste_bwd)
 
 
 # --------------------------------------------------------------------------
+# fp8 training matmul (round 21) — same STE discipline, e4m3 operands
+# --------------------------------------------------------------------------
+
+
+def _dynamic_quant_fp8(t):
+    """Per-TENSOR dynamic fp8 quantization: one f32 scale maps the
+    operand's amax onto the max finite e4m3 (448), the cast saturates at
+    the grid edge. Like :func:`_dynamic_quant`, re-derived every step —
+    the f32 master stays the source of truth and nothing fp8 is stored."""
+    amax = jnp.max(jnp.abs(t)).astype(jnp.float32)
+    scale = jnp.where(amax > 0, amax / FP8_MAX, 1.0)
+    return (t.astype(jnp.float32) / scale).astype(FP8_DTYPE), scale
+
+
+@jax.custom_vjp
+def fp8_ste_dot(x, w):
+    """fp8 quantized contraction: ``(..., d_in) x (d_in, d_out)`` with
+    BOTH operands dynamically cast to e4m3 and the contraction accumulated
+    in f32 (``preferred_element_type``) — the native fp8 MXU mode on
+    capable TPU generations, plain-convert emulation elsewhere. Dequant is
+    the product of the two per-tensor scales on the way out; backward is
+    straight-through (gradients of the UNquantized matmul), the exact
+    :func:`int8_ste_dot` treatment so the loss-parity and gradient tests
+    transfer. Returns f32 — callers cast to their activation dtype."""
+    qx, sx = _dynamic_quant_fp8(x)
+    qw, sw = _dynamic_quant_fp8(w)
+    acc = lax.dot_general(
+        qx, qw, dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return acc * (sx * sw)
+
+
+def _fp8_ste_fwd(x, w):
+    return fp8_ste_dot(x, w), (x, w)
+
+
+fp8_ste_dot.defvjp(_fp8_ste_fwd, _int8_ste_bwd)  # identical STE backward
+
+
+# --------------------------------------------------------------------------
 # int8-compressed gradient all-reduce (the bucket/outer-delta transform)
 # --------------------------------------------------------------------------
 
@@ -291,3 +356,47 @@ def int8_pmean(tree: Any, axis: str):
         out[i] = (s.astype(jnp.float32) * (scale / n)).astype(
             leaves[i].dtype)
     return jax.tree.unflatten(treedef, out)
+
+
+# --------------------------------------------------------------------------
+# lint contracts (analysis/programs.py provider)
+# --------------------------------------------------------------------------
+
+
+def lint_contracts():
+    """Contract for the fp8 STE training matmul (round 21) — the program
+    that actually EXERCISES the precision rule's fp8-dot gate. The
+    weight-only fp8 decode path never does: its e4m3 -> f32 widening cast
+    is a separate convert eqn, so the dot itself sees f32 operands. Here
+    ``fp8_ste_dot`` contracts e4m3 x e4m3 directly, and the gate checks
+    exactly what the kernel promises: e4m3fn-only operands, f32
+    accumulation via preferred_element_type, an f32 dequant mul on the
+    accumulator, and straight-through f32 gradients (no fp8 dot in the
+    backward — the bwd einsums run on the unquantized operands, which the
+    f32-operand policy check covers)."""
+    from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        ProgramContract,
+    )
+
+    N, D_IN, D_OUT = 8, 16, 32
+
+    def _build():
+        def loss(x, w):
+            return jnp.sum(fp8_ste_dot(x, w) ** 2)
+
+        fn = jax.value_and_grad(loss, argnums=(0, 1))
+        x = jax.ShapeDtypeStruct((N, D_IN), jnp.float32)
+        w = jax.ShapeDtypeStruct((D_IN, D_OUT), jnp.float32)
+        return fn, (x, w)
+
+    return [
+        ProgramContract(
+            name="fp8_ste_matmul_grad",
+            build=_build,
+            policy="fp8",
+            collectives={},  # single-shard: the quantizer is device-local
+            fp8_matmuls=True,
+            sources=("distributed_tensorflow_guide_tpu.ops.quant",),
+            notes="e4m3 operands, f32 accum via preferred_element_type, "
+                  "f32 dequant scales, straight-through backward"),
+    ]

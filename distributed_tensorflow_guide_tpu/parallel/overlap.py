@@ -243,7 +243,12 @@ def bucketed_loss_fn(loss_fn: Callable, axis: str,
             from distributed_tensorflow_guide_tpu.ops import autotune
 
             p_leaves = jax.tree.leaves(params)
-            bb = autotune.bucket_bytes_for(
+            # routed through the online front door for uniformity; the
+            # bucket family never sweeps here (no measure — only callers
+            # that can time a real train step, i.e. the benchmarks, may
+            # sweep it), so this is exactly bucket_bytes_for
+            bb = autotune.ensure_tuned_online(
+                autotune.BUCKET_KERNEL,
                 param_bytes=sum(_leaf_bytes(l) for l in p_leaves),
                 world=cc.axis_size(axis),
                 dtype=(np.int8 if compress == "int8"

@@ -317,7 +317,23 @@ class ServeEngine:
                  prefix_cache: bool = False,
                  host_blocks: int = 0, persist_cache: bool = False,
                  tenant_quotas=None, drr_quantum: int | None = None,
-                 adapters=None, recorder=None):
+                 adapters=None, recorder=None,
+                 online_tune: bool | None = None):
+        # online in-situ autotuning (round 21): True/False set the
+        # process-wide override (the tuning table is process state, so
+        # the knob is too — autotune.set_online_tune), None inherits the
+        # DTG_ONLINE_TUNE env gate. On a sweep-capable backend the first
+        # trace of an unseen (kernel, shape, dtype, device_kind) key then
+        # pays one bounded sweep during warmup instead of falling back to
+        # defaults; on CPU this is always a no-op (hermeticity contract).
+        if online_tune is not None:
+            from distributed_tensorflow_guide_tpu.ops import autotune
+            autotune.set_online_tune(online_tune)
+        if cfg.weight_dtype == "fp8":
+            from distributed_tensorflow_guide_tpu.core.precision import (
+                require_fp8,
+            )
+            require_fp8()
         self.fns = build_step_fns(
             cfg, slots=slots, num_blocks=num_blocks,
             block_size=block_size, prefill_chunk=prefill_chunk,
@@ -1078,6 +1094,7 @@ def lint_contracts():
                 decode_impl="pallas",
                 **({"lora_rank": 2, "lora_adapters": 2} if lora else {}),
                 **({"weight_dtype": "int8"} if kind == "decode_wq8"
+                   else {"weight_dtype": "fp8"} if kind == "decode_wqfp8"
                    else {}))
             fns = build_step_fns(cfg, slots=S, num_blocks=NB,
                                  block_size=BS, prefill_chunk=CH)
@@ -1178,6 +1195,26 @@ def lint_contracts():
                   "serve_decode_step with every projection kernel "
                   "stored int8 + f32 column scales, dequant fused into "
                   "the matmul (no f32 weight copy under the f32 cap)",
+            **common),
+        ProgramContract(
+            name="serve_decode_step_wqfp8",
+            build=_build("decode_wqfp8"),
+            # NOT fp8_matmuls: the e4m3 kernels widen through a separate
+            # convert eqn before the dot, so every contraction sees f32
+            # operands (the weight-only discipline) — there is no fp8 dot
+            # for the gate to pass. The pin reuses the int8 expect: fp8
+            # is the same 1 byte/elem storage, so the saved read bytes
+            # are identical (3 B x 4608 kernel elems vs the f32 sibling).
+            cost=CostSpec(
+                pins=(CostPin(
+                    "hbm_bytes_read", _wq8_hbm_read_expect,
+                    note="f32 decode read bytes minus 3 B x 4608 "
+                         "fp8-stored kernel elems (same byte diet as "
+                         "int8)"),),
+                max_peak_live_bytes=98304),
+            notes="weight-only fp8 decode: e4m3 projection kernels + f32 "
+                  "column scales, dequant fused into the matmul; relative "
+                  "(mantissa) error instead of int8's absolute grid",
             **common),
         ProgramContract(
             name="serve_prefill_chunk_step",

@@ -73,10 +73,19 @@ class TrainLoop:
         watchdog_action: Any = "interrupt",
         watchdog_diag_path: Any = None,
         recorder: Any = None,
+        online_tune: bool | None = None,
     ):
         if steps_per_call < 1:
             raise ValueError(
                 f"steps_per_call must be >= 1, got {steps_per_call}")
+        # online in-situ autotuning (round 21): True/False set the
+        # process-wide autotune override (the tuning table is process
+        # state, so the knob is too), None inherits DTG_ONLINE_TUNE. The
+        # first dispatch's trace then sweeps unseen kernel keys in situ
+        # on a sweep-capable backend; always a no-op on CPU.
+        if online_tune is not None:
+            from distributed_tensorflow_guide_tpu.ops import autotune
+            autotune.set_online_tune(online_tune)
         self.step_fn = step_fn
         self.state = state
         self.data = data

@@ -1,0 +1,206 @@
+"""fp8 across the stack (round 21): the e4m3 storage/training primitives,
+the capability gate, and the default-off contracts.
+
+The load-bearing pins:
+
+* ``fp8_ste_dot`` really contracts e4m3 x e4m3 with f32 accumulation and
+  its VJP is bit-identical to the unquantized matmul's (the same
+  straight-through contract as int8);
+* the fp8 levers are EXCLUSIVE (one quantized representation per policy
+  / per config) and default OFF — a default-config trace contains no
+  float8 dtype anywhere, so round-20 traces are byte-identical;
+* ``require_fp8`` refuses pre-fp8 device generations with an actionable
+  error (emulated e4m3 costs MORE than bf16), and ``DTG_FP8_EMULATE``
+  is the explicit escape for numerics work;
+* PRESETS["fp8"] trains the tiny LM against "f32" within a loss band —
+  wider than int8's (e4m3 has 3 mantissa bits vs int8's 8-bit grid).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_guide_tpu.analysis import walker
+from distributed_tensorflow_guide_tpu.core import precision
+from distributed_tensorflow_guide_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+)
+from distributed_tensorflow_guide_tpu.ops import quant
+
+CFG = TransformerConfig(vocab_size=64, num_layers=2, num_heads=2,
+                        d_model=16, d_ff=32, max_len=64, causal=True,
+                        dtype=jnp.float32)
+
+
+# ---- storage-side primitives ------------------------------------------------
+
+
+def test_quantize_channelwise_fp8_roundtrip():
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(32, 8).astype(np.float32))
+    q, scale = quant.quantize_channelwise(w, bits="fp8")
+    assert q.dtype == quant.FP8_DTYPE and scale.shape == (8,)
+    deq = q.astype(jnp.float32) * scale[None, :]
+    # e4m3 keeps 3 mantissa bits: worst-case relative step ~2^-3 on the
+    # stored value, so pin a per-column bound scaled by the column max
+    err = np.max(np.abs(np.asarray(deq - w)), axis=0)
+    colmax = np.max(np.abs(np.asarray(w)), axis=0)
+    assert np.all(err <= colmax * 0.0725)
+
+
+def test_wq_matmul_fp8_matches_unfused_oracle():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 32).astype(np.float32))
+    w = jnp.asarray(rng.randn(32, 8).astype(np.float32))
+    q, scale = quant.quantize_channelwise(w, bits="fp8")
+    got = quant.wq_matmul(x, q, scale, bits="fp8")
+    oracle = x @ (q.astype(jnp.float32) * scale[None, :])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(oracle),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_check_bits_error_names_fp8():
+    with pytest.raises(ValueError, match="fp8"):
+        quant.quantize_channelwise(jnp.ones((4, 4)), bits=3)
+
+
+def test_fp8_ste_dot_contracts_e4m3_and_grads_are_straight_through():
+    """The trace really contains an e4m3 x e4m3 -> f32 contraction (the
+    mode rules.py's fp8 gate legalizes), the forward stays within the
+    two-operand quantization bound, and the VJP is bit-identical to the
+    unquantized matmul's — the same straight-through contract as int8."""
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(4, 16).astype(np.float32))
+    w = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+    jx = jax.make_jaxpr(quant.fp8_ste_dot)(x, w)
+    dots = [e for e in walker.walk(jx.jaxpr)
+            if e.primitive.name == "dot_general"]
+    assert [str(v.aval.dtype) for v in dots[0].invars] == [
+        "float8_e4m3fn", "float8_e4m3fn"]
+    assert str(dots[0].outvars[0].aval.dtype) == "float32"
+
+    ref = x @ w
+    rel = float(jnp.max(jnp.abs(quant.fp8_ste_dot(x, w) - ref))
+                / jnp.max(jnp.abs(ref)))
+    assert rel < 0.15  # two e4m3 operands: ~2x the 3-mantissa-bit step
+
+    _, vjp_q = jax.vjp(quant.fp8_ste_dot, x, w)
+    _, vjp_f = jax.vjp(lambda a, b: a @ b, x, w)
+    ct = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+    for got, want in zip(vjp_q(ct), vjp_f(ct)):
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---- policy / config contracts ----------------------------------------------
+
+
+def test_policy_fp8_preset_and_exclusivity():
+    pol = precision.resolve("fp8")
+    assert pol.fp8_matmuls and not pol.quantized_matmuls
+    assert pol.compute_dtype == jnp.float32  # int8-style isolation
+    with pytest.raises(ValueError, match="exclusive"):
+        precision.Policy("both", quantized_matmuls=True, fp8_matmuls=True)
+
+
+def test_config_fp8_exclusions():
+    with pytest.raises(ValueError, match="exclusive"):
+        dataclasses.replace(CFG, fp8_matmuls=True, quantized_matmuls=True)
+    with pytest.raises(ValueError):
+        dataclasses.replace(CFG, fp8_matmuls=True, weight_dtype="fp8")
+    with pytest.raises(ValueError, match="weight_dtype"):
+        dataclasses.replace(CFG, weight_dtype="e5m2")
+    # each lever alone is a valid config
+    dataclasses.replace(CFG, fp8_matmuls=True)
+    dataclasses.replace(CFG, weight_dtype="fp8")
+
+
+def test_fp8_off_default_trace_has_no_float8():
+    """Default-off means OFF: a default-config trace contains no float8
+    dtype anywhere — which is why landing fp8 blessed zero existing
+    golden fingerprints (round-20 traces stay byte-identical)."""
+    assert CFG.fp8_matmuls is False and CFG.weight_dtype is None
+    model = Transformer(CFG)
+    x = jnp.zeros((2, 8), jnp.int32)
+    prm = model.init(jax.random.PRNGKey(0), x)["params"]
+    jx = jax.make_jaxpr(lambda p: model.apply({"params": p}, x))(prm)
+    assert "f8" not in str(jx)
+
+
+# ---- capability gate --------------------------------------------------------
+
+
+def test_fp8_capability_by_device_kind(monkeypatch):
+    monkeypatch.delenv(precision.FP8_EMULATE_ENV, raising=False)
+    assert precision.fp8_capable("TPU v6e")
+    assert precision.fp8_capable("TPU v7x")
+    assert not precision.fp8_capable("TPU v5 lite")
+    assert not precision.fp8_capable("TPU v4")
+    assert not precision.fp8_capable("cpu")
+
+
+def test_require_fp8_refuses_with_actionable_error(monkeypatch):
+    monkeypatch.delenv(precision.FP8_EMULATE_ENV, raising=False)
+    with pytest.raises(ValueError) as ei:
+        precision.require_fp8("TPU v5 lite")
+    msg = str(ei.value)
+    # the error must tell the user WHY (emulation is a net loss) and
+    # WHAT to do instead (bf16/int8, or the explicit emulation env)
+    assert "emulate" in msg and "bf16" in msg
+    assert precision.FP8_EMULATE_ENV in msg
+    precision.require_fp8("TPU v6e")  # capable kind passes
+
+
+def test_fp8_emulate_env_escape(monkeypatch):
+    monkeypatch.setenv(precision.FP8_EMULATE_ENV, "1")
+    assert precision.fp8_capable("cpu")
+    precision.require_fp8("TPU v4")  # no raise under the escape hatch
+    monkeypatch.setenv(precision.FP8_EMULATE_ENV, "0")
+    assert not precision.fp8_capable("cpu")
+
+
+# ---- training parity --------------------------------------------------------
+
+
+def test_fp8_policy_loss_parity_with_f32():
+    """PRESETS["fp8"] trains the tiny LM step-for-step against "f32" —
+    same f32 masters, same everything except the projection contraction
+    representation (the int8 parity pin's geometry, wider band: e4m3's
+    3 mantissa bits are coarser than the int8 grid)."""
+    small = dataclasses.replace(CFG, max_len=32)
+
+    def train(cfg, steps=5):
+        model = Transformer(cfg)
+        prm = model.init(jax.random.PRNGKey(0),
+                         jnp.zeros((2, 8), jnp.int32))["params"]
+        tx = optax.adam(1e-2)
+        opt = tx.init(prm)
+        xs = np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (steps, 4, 8)).astype(np.int32)
+
+        @jax.jit
+        def step(prm, opt, x):
+            def loss_fn(p):
+                lp = jax.nn.log_softmax(
+                    model.apply({"params": p}, x[:, :-1]), -1)
+                return -jnp.mean(jnp.take_along_axis(
+                    lp, x[:, 1:, None], -1))
+
+            loss, g = jax.value_and_grad(loss_fn)(prm)
+            up, opt = tx.update(g, opt, prm)
+            return optax.apply_updates(prm, up), opt, loss
+
+        out = []
+        for x in xs:
+            prm, opt, loss = step(prm, opt, x)
+            out.append(float(loss))
+        return out
+
+    l_f32 = train(precision.PRESETS["f32"].apply_to_transformer(small))
+    l_fp8 = train(precision.PRESETS["fp8"].apply_to_transformer(small))
+    for a, b in zip(l_f32, l_fp8):
+        assert abs(a - b) / a < 5e-2

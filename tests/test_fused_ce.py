@@ -375,8 +375,9 @@ def test_precision_presets_and_apply():
     assert cfg2.remat is True and cfg2.resolved_remat_mode == "block"
     assert precision.resolve(None).name == "bf16"
     assert precision.resolve(pol) is pol
+    assert precision.resolve("fp8").name == "fp8"  # round 21: now a preset
     with pytest.raises(ValueError, match="unknown precision"):
-        precision.resolve("fp8")
+        precision.resolve("fp6")
     with pytest.raises(ValueError, match="remat"):
         precision.Policy("bad", remat="everything")
 

@@ -1,0 +1,184 @@
+"""Online in-situ autotuning (round 21): the ``ensure_tuned_online``
+front door in ops/autotune.py.
+
+The three hard bounds from the module contract, each pinned here:
+
+* **default-off**: with ``DTG_ONLINE_TUNE`` unset nothing sweeps, even
+  on a tpu-platform key with a measure injected;
+* **CPU-hermetic**: with the env SET, the cpu platform is bitwise the
+  fallback path — no sweep runs, no table file appears, the attempted
+  counter stays zero (so CPU tier-1 can run with the env exported and
+  stay byte-identical to a run without it);
+* **bounded + once**: a first-touch key sweeps ONCE and persists
+  through the crash-safe table (a simulated restart serves it as a
+  lookup hit), a zero budget blocks all sweeps, and a key whose sweep
+  RAISES is marked attempted and never retried (serving loops must not
+  re-pay a failing sweep).
+
+The sweep mechanism runs with an INJECTED measure function and platform
+forced to "tpu" — the same discipline as tests/test_autotune.py.
+"""
+
+import os
+from pathlib import Path
+
+import jax.numpy as jnp
+import pytest
+
+from distributed_tensorflow_guide_tpu.ops import autotune
+from distributed_tensorflow_guide_tpu.ops import decode_attention as DA
+
+
+@pytest.fixture(autouse=True)
+def _isolated_table(isolated_autotune_table):
+    yield
+
+
+KW = dict(b=1, h=1, s=256, d=64, dtype=jnp.float32)
+
+
+def _table_file() -> Path:
+    return Path(os.environ["DTG_AUTOTUNE_TABLE"])
+
+
+def _spy():
+    calls = []
+
+    def measure(kernel, blocks):
+        calls.append(blocks)
+        return 1.0 / (blocks[0] * blocks[1])  # favors the largest blocks
+
+    return calls, measure
+
+
+def test_default_off_no_sweep_even_on_tpu_keys(monkeypatch):
+    monkeypatch.delenv("DTG_ONLINE_TUNE", raising=False)
+    calls, measure = _spy()
+    out = autotune.ensure_tuned_online("flash_fwd", measure=measure,
+                                       platform="tpu", **KW)
+    assert out == autotune.blocks_for("flash_fwd", platform="tpu", **KW)
+    assert calls == []
+    assert autotune.online_tune_stats()["attempted"] == 0
+
+
+def test_cpu_hermetic_with_env_set(monkeypatch):
+    """The tier-1 contract: exporting DTG_ONLINE_TUNE must not change a
+    single byte of CPU behavior — no sweeps, no table I/O, and every
+    resolver returns exactly its fallback."""
+    monkeypatch.setenv("DTG_ONLINE_TUNE", "1")
+    assert autotune.online_tune_enabled()
+    calls, measure = _spy()
+
+    # flash family through the front door (platform resolves to cpu)
+    out = autotune.ensure_tuned_online("flash_fwd", measure=measure, **KW)
+    assert out == autotune.blocks_for("flash_fwd", **KW)
+    # CE chunk and DP bucket families
+    ce = autotune.ensure_tuned_online(
+        autotune.CE_KERNEL, measure=measure, n=128, d=64, v=256,
+        dtype=jnp.float32)
+    assert ce == autotune.ce_chunk_for(n=128, d=64, v=256,
+                                       dtype=jnp.float32)
+    # the real decode call sites (the serving hot path)
+    blk = DA.decode_blk_k_for(b=1, h=2, s=256, d=64, dtype=jnp.float32)
+    assert 256 % blk == 0
+    pblk = DA.paged_decode_blk_k_for(b=1, h=2, s=256, d=64,
+                                     dtype=jnp.float32, block_size=64)
+    assert 64 % pblk == 0
+
+    assert calls == []
+    assert not _table_file().exists()
+    assert autotune.online_tune_stats()["attempted"] == 0
+
+
+def test_online_sweep_once_persists_then_lookup_hits(monkeypatch):
+    monkeypatch.setenv("DTG_ONLINE_TUNE", "1")
+    calls, measure = _spy()
+    first = autotune.ensure_tuned_online("flash_fwd", measure=measure,
+                                         platform="tpu", **KW)
+    assert first == (256, 256)
+    n_swept = len(calls)
+    assert n_swept == len(autotune.candidate_blocks(
+        "flash_fwd", s=KW["s"], d=KW["d"], dtype=jnp.float32))
+    assert _table_file().exists()
+
+    again = autotune.ensure_tuned_online("flash_fwd", measure=measure,
+                                         platform="tpu", **KW)
+    assert again == first and len(calls) == n_swept  # no re-sweep
+
+    stats = autotune.online_tune_stats()
+    assert stats["attempted"] == 1
+    assert 0 <= stats["spent_s"] <= stats["budget_s"]
+
+    # "restart": in-memory state dropped, the persisted entry serves the
+    # key as a lookup hit — still no second sweep
+    autotune.reset()
+    reloaded = autotune.ensure_tuned_online("flash_fwd", measure=measure,
+                                            platform="tpu", **KW)
+    assert reloaded == first and len(calls) == n_swept
+    assert autotune.online_tune_stats()["attempted"] == 0  # hit, not try
+
+
+def test_zero_budget_blocks_all_sweeps(monkeypatch):
+    monkeypatch.setenv("DTG_ONLINE_TUNE", "1")
+    monkeypatch.setenv("DTG_ONLINE_TUNE_BUDGET_S", "0")
+    calls, measure = _spy()
+    out = autotune.ensure_tuned_online("flash_fwd", measure=measure,
+                                       platform="tpu", **KW)
+    assert out == autotune.blocks_for("flash_fwd", platform="tpu", **KW)
+    assert calls == [] and not _table_file().exists()
+
+
+def test_failed_sweep_marks_attempted_never_retries(monkeypatch):
+    """A sweep whose every candidate fails (per-candidate isolation in
+    ensure_tuned tries each once) resolves to the fallback, and the key
+    is marked attempted — the NEXT resolution calls no measure at all, a
+    serving loop never re-pays a failing sweep."""
+    monkeypatch.setenv("DTG_ONLINE_TUNE", "1")
+    calls = []
+
+    def measure(kernel, blocks):
+        calls.append(blocks)
+        raise RuntimeError("chip flaked mid-sweep")
+
+    fallback = autotune.blocks_for("flash_fwd", platform="tpu", **KW)
+    n_cands = len(autotune.candidate_blocks(
+        "flash_fwd", s=KW["s"], d=KW["d"], dtype=jnp.float32))
+    first = autotune.ensure_tuned_online("flash_fwd", measure=measure,
+                                         platform="tpu", **KW)
+    assert first == fallback and len(calls) == n_cands
+    again = autotune.ensure_tuned_online("flash_fwd", measure=measure,
+                                         platform="tpu", **KW)
+    assert again == fallback and len(calls) == n_cands  # attempted-once
+    assert autotune.online_tune_stats()["attempted"] == 1
+
+
+def test_bucket_kernel_needs_measure_even_when_enabled(monkeypatch):
+    """The bucket family has no self-contained runner: without a caller-
+    supplied measure the front door must resolve to the default, not
+    attempt anything."""
+    monkeypatch.setenv("DTG_ONLINE_TUNE", "1")
+    key = dict(param_bytes=1 << 20, world=8, dtype=jnp.float32)
+    out = autotune.ensure_tuned_online(autotune.BUCKET_KERNEL,
+                                       platform="tpu", **key)
+    assert out == autotune.bucket_bytes_for(platform="tpu", **key)
+    assert autotune.online_tune_stats()["attempted"] == 0
+
+
+def test_set_online_tune_override_wins_over_env(monkeypatch):
+    monkeypatch.delenv("DTG_ONLINE_TUNE", raising=False)
+    assert not autotune.online_tune_enabled()
+    prev = autotune.set_online_tune(True)
+    assert prev is None and autotune.online_tune_enabled()
+    monkeypatch.setenv("DTG_ONLINE_TUNE", "1")
+    autotune.set_online_tune(False)
+    assert not autotune.online_tune_enabled()  # override beats truthy env
+    autotune.set_online_tune(None)
+    assert autotune.online_tune_enabled()  # cleared -> env gate again
+
+
+def test_decode_kernels_require_explicit_fallback(monkeypatch):
+    monkeypatch.delenv("DTG_ONLINE_TUNE", raising=False)
+    for kernel in (autotune.DECODE_KERNEL, autotune.PAGED_DECODE_KERNEL):
+        with pytest.raises(ValueError, match="fallback"):
+            autotune.ensure_tuned_online(kernel, b=1, h=2, s=256, d=64,
+                                         dtype=jnp.float32, causal=False)
