@@ -62,6 +62,20 @@ hierarchy moves COST, never CONTENT. ``--persist-cache`` adds the
 warm-restart leg: the warm cache (spilled blocks + trie) snapshots to
 disk, restores into a fresh engine, and every session's final turn
 replays with zero cached-prefix re-prefill.
+
+``--fleet N`` adds the scale-out phase (PR 18): the top-rate mix drives
+an N-replica :class:`FleetScheduler` — global admission, fleet-wide
+per-tenant DRR and request->replica routing over N stock engines running
+the same two jitted serve programs — against the single-engine side
+already measured, in one invocation. ``--fleet-roles disagg`` splits
+prefill and decode roles: each stream's written KV blocks are exported
+at the phase flip and shipped to a decode replica (counted, priced
+against the DCN roofline, reconciled by ``obs/recon``).
+``--fleet-prefix`` routes each request to the replica holding its
+longest cached prefix, pinned by a repeat wave. Reported:
+``fleet_goodput_gain`` vs the single engine, the disagg TTFT/TPOT
+split, ``prefix_route_hits`` and the migrated-stream bitwise verdict —
+placement moves COST, never CONTENT.
 """
 
 import argparse
@@ -149,6 +163,24 @@ def main() -> None:
                          "run, restore it into a fresh engine and "
                          "replay every session's final turn — pins "
                          "zero cached-prefix re-prefill")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="add the scale-out phase (PR 18): the top-rate "
+                         "mix drives an N-replica FleetScheduler (global "
+                         "admission + per-tenant DRR + routing over "
+                         "stock engines) against the single-engine side "
+                         "already measured — fleet goodput A/B, the "
+                         "disagg TTFT/TPOT split, prefix-route hits and "
+                         "the migrated-stream bitwise verdict (0 = off)")
+    ap.add_argument("--fleet-roles", choices=["colocated", "disagg"],
+                    default="colocated",
+                    help="fleet placement policy: 'disagg' alternates "
+                         "prefill/decode roles and ships each stream's "
+                         "KV blocks prefill->decode at the phase flip "
+                         "(counted and priced against the DCN roofline)")
+    ap.add_argument("--fleet-prefix", action="store_true",
+                    help="fleet-level prefix routing: requests route to "
+                         "the replica holding their longest cached "
+                         "prefix (turns the per-replica prefix cache on)")
     ap.add_argument("--lora-rank", type=int, default=0,
                     help="serve the continuous side multi-LoRA: each "
                          "request decodes under adapter rid %% 4 (0 = "
@@ -972,6 +1004,141 @@ def main() -> None:
         else:
             e_on.close()
 
+    # ---- scale-out fleet phase (PR 18) -----------------------------------
+    fleet_extras = {}
+    if args.fleet:
+        from benchmarks.common import dcn_extras, device_dcn_peak
+        from distributed_tensorflow_guide_tpu.obs import recon as obs_recon
+        from distributed_tensorflow_guide_tpu.serve.fleet import (
+            FleetScheduler,
+        )
+
+        fl = FleetScheduler(
+            serve_cfg, params, replicas=args.fleet,
+            roles=args.fleet_roles,
+            slots=args.slots, num_blocks=args.num_blocks,
+            block_size=args.block_size, prefill_chunk=args.prefill_chunk,
+            temperature=0.0, adapters=bank,
+            prefix_cache=args.fleet_prefix,
+            host_blocks=args.host_blocks)
+
+        def drive_fleet(workload):
+            """The fleet's virtual-clock driver: same discipline as
+            ``drive``, except a tick is charged the SLOWEST replica's
+            measured wall time plus the supervisor's own overhead (the
+            in-process loop steps replicas serially, but they are
+            independent machines); idle ticks fast-forward to the
+            fleet-wide next arrival."""
+            for rid, arr, toks, M, *rest in workload:
+                fl.submit(Request(
+                    rid=rid, prompt=toks, max_new_tokens=M,
+                    rng=jax.random.PRNGKey(rid % (1 << 20)),
+                    arrival=arr, adapter=adapter_of(rid),
+                    tenant=rest[0] if rest else 0))
+            now, events = 0.0, []
+            while fl._has_work():
+                t0 = time.perf_counter()
+                evs, kind = fl.step(now)
+                total = time.perf_counter() - t0
+                if kind == "idle":
+                    nxt = fl.next_arrival()
+                    if nxt is None:
+                        break
+                    now = max(now, nxt)
+                    continue
+                per_replica = list(fl.step_secs.values())
+                now += total - sum(per_replica) + max(per_replica,
+                                                      default=0.0)
+                events.extend(
+                    dataclasses.replace(ev, time=now) for ev in evs)
+            return events
+
+        # N replicas are provisioned for N x the single engine's
+        # calibrated capacity, so the A/B offers BOTH sides that rate:
+        # the single engine saturates (queueing blows its SLOs), the
+        # fleet keeps pace — that headroom is the point of scale-out.
+        # The length/token draw is seed-identical across tags (only
+        # rids shift), so the sides — and the bitwise cross-check —
+        # stay apples-to-apples.
+        rate_f = args.fleet * rates[top]
+        wl_fleet = make_workload(rate_f, args.requests, tag=60)
+        ev_f = drive_fleet(wl_fleet)
+        lat_f = latencies(ev_f, wl_fleet)
+        fleet_good = goodput(lat_f, slo_ttft, slo_tpot, wl_fleet[0][1])
+        if args.fleet_prefix:
+            # a repeat wave with the SAME prompts (fresh rids): every
+            # request now has a warm prefix somewhere in the fleet, and
+            # the router must concentrate it there instead of diluting
+            drive_fleet(make_workload(rate_f, args.requests, tag=61))
+        fh = fl.health()
+        fl.check_leaks()
+        comps = fl.completions()
+        wl_one = make_workload(rate_f, args.requests, tag=62)
+        ev_one, _ = drive(wl_one)
+        lat_one = latencies(ev_one, wl_one)
+        single_good = goodput(lat_one, slo_ttft, slo_tpot, wl_one[0][1])
+        base_rid = 62 * 100000
+        mig = sorted(set(fl.migrated_rids))
+
+        def fleet_matches(rid):
+            return np.array_equal(
+                np.asarray(comps.get(rid, []), np.int32),
+                np.asarray(eng.sched.emitted.get(
+                    base_rid + rid % 100000, []), np.int32))
+
+        bitwise_mig = all(fleet_matches(r) for r in mig)
+        bitwise_all = all(fleet_matches(60 * 100000 + i)
+                          for i in range(args.requests))
+        def p50(lat, j):
+            return float(np.median([x[j] for x in lat])) if lat else 0.0
+
+        fleet_extras = {
+            "fleet_replicas": args.fleet,
+            "fleet_roles": args.fleet_roles,
+            "fleet_prefix_routing": bool(args.fleet_prefix),
+            "fleet_offered_req_per_s": round(rate_f, 3),
+            "fleet_goodput": round(fleet_good, 2),
+            "single_goodput_at_fleet_rate": round(single_good, 2),
+            "fleet_goodput_gain": round(
+                fleet_good / max(single_good, 1e-9), 3),
+            "fleet_ttft_p50": round(p50(lat_f, 0), 4),
+            "fleet_tpot_p50": round(p50(lat_f, 1), 4),
+            "single_ttft_p50": round(p50(lat_one, 0), 4),
+            "single_tpot_p50": round(p50(lat_one, 1), 4),
+            "fleet_completed": len(lat_f),
+            "fleet_migrations": fh["migrations"],
+            "fleet_migration_bytes": fh["migration_bytes"],
+            "prefix_route_hits": fh["prefix_route_hits"],
+            "prefix_route_hit_tokens": fh["prefix_route_hit_tokens"],
+            "migrated_streams": len(mig),
+            "migrated_streams_bitwise_identical": bitwise_mig,
+            "fleet_streams_bitwise_identical": bitwise_all,
+            "fleet_autoscale_signal": fl.autoscale_signal(),
+        }
+        if fh["migration_bytes"]:
+            # the disagg KV handoff priced like every other DCN-tier
+            # bench: bytes + achieved rate + roofline fraction (modeled
+            # off-TPU), then obs/recon's modeled-vs-measured join against
+            # the serve_kv_block_transfer_dcn cost shape
+            fleet_extras.update(dcn_extras(
+                fh["migration_bytes"], fh["migration_secs"],
+                assumed_gbytes_per_s=25.0))
+            roof = dataclasses.replace(
+                obs_recon.Roofline.from_env(),
+                peak_ici_bytes_s=device_dcn_peak() or 25e9)
+            r = obs_recon.reconcile(
+                {"flops": 0.0, "hbm_bytes": 0.0,
+                 "collective_bytes": {
+                     "ppermute[dcn]": float(fh["migration_bytes"])}},
+                max(fh["migration_secs"], 1e-9), roof)
+            fleet_extras["migration_recon"] = {
+                "achieved_gb_s": round(r["achieved_ici_gb_s"], 3),
+                "dcn_frac": (round(r["ici_frac"], 6)
+                             if r["ici_frac"] is not None else None),
+                "bound": r["bound"],
+            }
+        fl.close()
+
     # ---- the JSON line ---------------------------------------------------
     side = cont_good if args.mode == "continuous" else static_good
     other = static_good if args.mode == "continuous" else cont_good
@@ -1011,6 +1178,7 @@ def main() -> None:
     extras.update(chaos_extras)
     extras.update(prefix_extras)
     extras.update(longtail_extras)
+    extras.update(fleet_extras)
     report("serve_goodput", side[top], "tokens/sec",
            baseline=other[top] if other[top] > 0 else None,
            **extras)

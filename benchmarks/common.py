@@ -590,6 +590,40 @@ def spill_extras(d2h_bytes: float, h2d_bytes: float,
     return out
 
 
+def kv_migration_bytes_terms(n_blocks: int, num_layers: int,
+                             num_heads: int, block_size: int,
+                             head_dim: int,
+                             kv_dtype: str | None = None, *,
+                             activation_dtype_bytes: int = 2) -> dict:
+    """Closed-form payload bytes of migrating ``n_blocks`` written KV
+    blocks between fleet replicas (disaggregated prefill->decode
+    handoff, PR 18), split into terms.
+
+    A migration ships exactly the rows one demotion of the same blocks
+    would spill (:func:`spill_block_bytes_terms` — the engine's fused
+    d2h gather produces the payload for both paths), so the per-block
+    term is shared and the reconciliation against the fleet's traced
+    ``migration_bytes`` counter is equality, not a bound.  The same
+    total prices the compiled-side DCN model: the
+    ``serve_kv_block_transfer_dcn`` program's ``collective_bytes`` pin
+    is this closed form divided by the slice count (the cost walker's
+    per-device ppermute convention)."""
+    per_block = spill_block_bytes_terms(
+        num_layers, num_heads, block_size, head_dim, kv_dtype,
+        activation_dtype_bytes=activation_dtype_bytes)
+    return {k: float(n_blocks) * v for k, v in per_block.items()}
+
+
+def kv_migration_bytes(n_blocks: int, num_layers: int, num_heads: int,
+                       block_size: int, head_dim: int,
+                       kv_dtype: str | None = None, *,
+                       activation_dtype_bytes: int = 2) -> float:
+    """Headline total of :func:`kv_migration_bytes_terms`."""
+    return sum(kv_migration_bytes_terms(
+        n_blocks, num_layers, num_heads, block_size, head_dim, kv_dtype,
+        activation_dtype_bytes=activation_dtype_bytes).values())
+
+
 def ici_extras(comm_bytes: float, comm_secs: float | None) -> dict:
     """Extra report() keys for interconnect-honest benches: the closed-form
     per-device comm bytes, and — when the caller measured the comm time

@@ -181,40 +181,40 @@ BATTERY: list[tuple[str, list[str], int]] = [
      ["benchmarks/bench_serving.py", "--mode", "static",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
-      "--host-blocks", "0"], 1800),
+      "--host-blocks", "0", "--fleet", "0"], 1800),
     ("serve_paged",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
-      "--host-blocks", "0"], 1800),
+      "--host-blocks", "0", "--fleet", "0"], 1800),
     ("serve_chunked_prefill",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "8", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
-      "--host-blocks", "0"], 1800),
+      "--host-blocks", "0", "--fleet", "0"], 1800),
     ("serve_kv_int8",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "int8",
       "--decode-impl", "dense", "--weight-dtype", "model",
-      "--host-blocks", "0"], 1800),
+      "--host-blocks", "0", "--fleet", "0"], 1800),
     ("serve_pallas",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "pallas", "--weight-dtype", "model",
-      "--host-blocks", "0"], 1800),
+      "--host-blocks", "0", "--fleet", "0"], 1800),
     # serving under fire (PR 11): one knob each — serve_paged + the
     # chaos storm, then + the mid-run kill/snapshot-restore leg
     ("serve_chaos",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
-      "--host-blocks", "0",
+      "--host-blocks", "0", "--fleet", "0",
       "--chaos"], 1800),
     ("serve_snapshot_restore",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
-      "--host-blocks", "0",
+      "--host-blocks", "0", "--fleet", "0",
       "--chaos", "--snapshot-restore"], 1800),
     # prefix sharing + tenancy (PR 12): one knob each — chunked prefill
     # + the prefix-mix phase (prefix cache ON vs OFF in one run), the
@@ -224,19 +224,19 @@ BATTERY: list[tuple[str, list[str], int]] = [
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "8", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
-      "--host-blocks", "0",
+      "--host-blocks", "0", "--fleet", "0",
       "--prefix-mix", "3"], 1800),
     ("serve_multi_tenant",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
-      "--host-blocks", "0",
+      "--host-blocks", "0", "--fleet", "0",
       "--prefix-mix", "4"], 1800),
     ("serve_lora",
      ["benchmarks/bench_serving.py", "--mode", "continuous",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
-      "--host-blocks", "0",
+      "--host-blocks", "0", "--fleet", "0",
       "--prefix-mix", "3", "--lora-rank", "2"], 1800),
     # cache hierarchy (PR 16): one knob each — serve_continuity + the
     # longtail phase (hierarchy ON vs pool-only OFF in one run), then
@@ -245,14 +245,36 @@ BATTERY: list[tuple[str, list[str], int]] = [
      ["benchmarks/bench_serving.py", "--mode", "static",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
-      "--host-blocks", "0",
+      "--host-blocks", "0", "--fleet", "0",
       "--longtail-mix", "6"], 1800),
     ("serve_warm_restart",
      ["benchmarks/bench_serving.py", "--mode", "static",
       "--prefill-chunk", "32", "--kv-dtype", "model",
       "--decode-impl", "dense", "--weight-dtype", "model",
-      "--host-blocks", "0",
+      "--host-blocks", "0", "--fleet", "0",
       "--longtail-mix", "6", "--persist-cache"], 1800),
+    # scale-out fleet (PR 18): one knob each vs serve_continuity — the
+    # N-replica fleet tier (global admission/DRR/routing over stock
+    # engines), + disaggregated prefill/decode roles (KV blocks shipped
+    # prefill->decode, priced against the DCN roofline), + fleet-level
+    # prefix routing (longest-cached-prefix replica wins)
+    ("serve_fleet",
+     ["benchmarks/bench_serving.py", "--mode", "static",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0", "--fleet", "2"], 1800),
+    ("serve_disagg",
+     ["benchmarks/bench_serving.py", "--mode", "static",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0", "--fleet", "2",
+      "--fleet-roles", "disagg"], 1800),
+    ("serve_fleet_prefix",
+     ["benchmarks/bench_serving.py", "--mode", "static",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0", "--fleet", "2",
+      "--fleet-prefix"], 1800),
     ("ring_attention_1024",
      ["benchmarks/bench_ring_attention.py", "--seq-len", "1024"], 1500),
     ("ring_attention_2048",
@@ -343,6 +365,12 @@ ROW_PROGRAMS: dict[str, str] = {
     "serve_paged": "serve_decode_step",
     "serve_chunked_prefill": "serve_prefill_chunk_step",
     "serve_lora": "serve_decode_step_lora",
+    # fleet replicas run the SAME decode program; the disagg row's hot
+    # seam is the cross-replica KV handoff, so it joins to the DCN
+    # block-transfer program instead
+    "serve_fleet": "serve_decode_step",
+    "serve_disagg": "serve_kv_block_transfer_dcn",
+    "serve_fleet_prefix": "serve_decode_step",
 }
 
 

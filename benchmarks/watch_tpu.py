@@ -62,6 +62,9 @@ FIRST_WINDOW = [
     "serve_lora",              # + batched multi-LoRA decode
     "serve_spill",             # KV cache hierarchy A/B (PR 16),
     "serve_warm_restart",      # + warm cache persistence leg
+    "serve_fleet",             # scale-out fleet A/B (PR 18),
+    "serve_disagg",            # + disaggregated prefill/decode roles,
+    "serve_fleet_prefix",      # + fleet-level prefix routing
     "gpt2_pp_fused_ce",
     "gpt2_pp_gpipe",
     "gpt2_flash_seq1024",

@@ -206,6 +206,41 @@ def absorb_engine(reg: Registry, health: dict) -> None:
                         labels={"tenant": str(tenant)}).set_total(v)
 
 
+def absorb_fleet(reg: Registry, health: dict) -> None:
+    """``FleetScheduler.health()`` -> ``dtg_fleet_*`` metrics: fleet
+    counters and the global tenant aggregation at the top level,
+    per-replica engine healths re-absorbed through
+    :func:`absorb_engine`'s scheme under a ``replica`` label."""
+    reg.gauge("dtg_fleet_queued").set(health.get("queued", 0))
+    reg.gauge("dtg_fleet_live_replicas").set(
+        health.get("live_replicas", 0))
+    reg.gauge("dtg_fleet_generation").set(health.get("generation", 0))
+    for k in ("shed", "completed", "migrations", "migration_bytes",
+              "replicas_shed", "replicas_regrown", "prefix_route_hits",
+              "prefix_route_hit_tokens"):
+        if k in health:
+            reg.counter(f"dtg_fleet_{k}_total").set_total(health[k])
+    if "migration_secs" in health:
+        reg.gauge("dtg_fleet_migration_s").set(health["migration_secs"])
+    for tenant, c in (health.get("tenants") or {}).items():
+        for k, v in c.items():
+            reg.counter(f"dtg_fleet_tenant_{k}_total",
+                        labels={"tenant": str(tenant)}).set_total(v)
+    for i, h in enumerate(health.get("replicas") or []):
+        labels = {"replica": str(i), "role": str(h.get("role", ""))}
+        reg.gauge("dtg_fleet_replica_live", labels=labels).set(
+            1.0 if h.get("live") else 0.0)
+        for k in ("resident", "queued", "live_blocks"):
+            if k in h:
+                reg.gauge(f"dtg_fleet_replica_{k}",
+                          labels=labels).set(h[k])
+        for k in ("completed", "shed", "preemptions",
+                  "migrated_out", "migrated_in"):
+            if k in h:
+                reg.counter(f"dtg_fleet_replica_{k}_total",
+                            labels=labels).set_total(h[k])
+
+
 def absorb_pool(reg: Registry, stats: dict) -> None:
     """``BlockPool.stats()`` -> ``dtg_serve_pool_*`` gauges."""
     for k, v in stats.items():

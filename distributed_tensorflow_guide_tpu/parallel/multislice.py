@@ -369,6 +369,35 @@ class MultiSliceLocalSGD:
         return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
+def make_kv_block_transfer(mesh: Mesh, *, src_slice: int = 0,
+                           dst_slice: int = 1):
+    """Compiled-side model of the fleet's disaggregated prefill->decode
+    KV handoff (PR 18): ship a ``(blocks, payload)`` buffer from the
+    prefill-role slice to the decode-role slice with ONE single-hop
+    ``lax.ppermute`` over the ``dcn`` axis — a point-to-point send, not
+    a ring, because a migration has exactly one producer and one
+    consumer.  The buffer is donated (alias mode: the transfer replaces
+    it in place on the wire's far side).  The host-side fleet moves the
+    same bytes through its d2h/h2d path today; this program is what the
+    cost walker prices so the `collective_bytes` pin can hold the
+    closed-form migration model (``kv_migration_bytes``) against an
+    auditable trace, and what a future device-to-device DCN fast path
+    compiles to."""
+    n = axis_sizes(mesh)[DCN_AXIS]
+    if n < 2:
+        raise ValueError(
+            f"kv block transfer needs >= 2 slices on {DCN_AXIS!r}, "
+            f"got {n}")
+    perm = [(src_slice % n, dst_slice % n)]
+
+    def xfer(buf):
+        return lax.ppermute(buf, DCN_AXIS, perm)
+
+    sharded = shard_map(xfer, mesh=mesh, in_specs=P(DCN_AXIS),
+                        out_specs=P(DCN_AXIS), check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
 # ---- program contracts (analysis/) ------------------------------------------
 
 
@@ -480,4 +509,60 @@ def lint_contracts():
                 max_peak_live_bytes=49152),
             notes="outer=off is DCN-free by contract (bench timing "
                   "control)"),
+        _kv_transfer_contract(),
     ]
+
+
+def _kv_transfer_contract():
+    """Contract for the fleet KV-block migration program (PR 18): one
+    point-to-point ppermute on the dcn axis and NOTHING else (strict
+    census — a stray psum here would mean the migration path grew a
+    synchronization it must not have), with an EXACT ``collective_bytes``
+    pin against the closed-form migration model: the fixture's
+    ``kv_migration_bytes`` divided by the slice count (the cost walker's
+    per-device ppermute convention — bytes x hops / n_devices, one hop
+    for a point-to-point send)."""
+    from distributed_tensorflow_guide_tpu.analysis.contracts import (
+        CostPin,
+        CostSpec,
+        DonationSpec,
+        ProgramContract,
+    )
+    from distributed_tensorflow_guide_tpu.analysis.cost import closed_forms
+
+    # fixture geometry mirrors the serve lint fixtures (L=2 layers, H=2
+    # heads, 8-token blocks, head_dim 8) with 4 migrated blocks per
+    # slice; payload rows are f32 here (the lint policy dtype), so the
+    # closed form is evaluated at 4 bytes/elem
+    L, H, BS, HD, NB = 2, 2, 8, 8, 4
+    n_slices = 2
+
+    def _xfer_expect():
+        return closed_forms().kv_migration_bytes(
+            NB, L, H, BS, HD, activation_dtype_bytes=4) / n_slices
+
+    def _build():
+        from distributed_tensorflow_guide_tpu.core.mesh import MeshSpec
+
+        mesh = two_tier_mesh(MeshSpec(data=-1), n_slices=n_slices)
+        fn = make_kv_block_transfer(mesh)
+        elems_per_block = 2 * L * H * BS * HD  # k and v rows
+        buf = jnp.zeros((n_slices * NB, elems_per_block), jnp.float32)
+        return fn, (buf,)
+
+    return ProgramContract(
+        name="serve_kv_block_transfer_dcn",
+        build=_build,
+        policy="f32",
+        collectives={"ppermute[dcn]": 1},
+        donation=DonationSpec(argnums=(0,)),
+        sources=("distributed_tensorflow_guide_tpu.parallel.multislice",),
+        cost=CostSpec(
+            pins=(CostPin(
+                "collective_bytes[ppermute[dcn]]", _xfer_expect,
+                note="kv_migration_bytes(4 blocks, f32) / n_slices — "
+                     "the closed-form migration model at the walker's "
+                     "per-device single-hop convention"),),
+            max_peak_live_bytes=49152),
+        notes="point-to-point KV block handoff over DCN: the compiled "
+              "model the fleet's migration counters reconcile against")

@@ -1,7 +1,8 @@
 """Continuous-batching serving: paged KV pool + request scheduler +
 two static step programs, with prefix-sharing COW blocks, multi-tenant
-fair-share admission and batched multi-LoRA decode (see
-docs/serving.md)."""
+fair-share admission, batched multi-LoRA decode and a scale-out fleet
+tier (global admission/DRR/routing over N stock engines, disaggregated
+prefill/decode, fleet-level prefix routing — see docs/serving.md)."""
 
 from distributed_tensorflow_guide_tpu.serve.engine import (
     Event,
@@ -11,6 +12,9 @@ from distributed_tensorflow_guide_tpu.serve.engine import (
     init_adapter_bank,
     paged_cache_pool,
     paged_config,
+)
+from distributed_tensorflow_guide_tpu.serve.fleet import (
+    FleetScheduler,
 )
 from distributed_tensorflow_guide_tpu.serve.scheduler import (
     EngineOverloaded,
@@ -36,6 +40,7 @@ __all__ = [
     "BlockStore",
     "EngineOverloaded",
     "Event",
+    "FleetScheduler",
     "PrefixIndex",
     "Request",
     "Scheduler",

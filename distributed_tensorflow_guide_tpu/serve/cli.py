@@ -38,6 +38,15 @@ def main() -> None:
                     help="serve the mix multi-LoRA: requests cycle "
                          "through 3 adapters (0 = base) inside the "
                          "shared decode step")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="serve through an N-replica FleetScheduler "
+                         "(global admission/DRR/routing over N stock "
+                         "engines) instead of a single engine; watch "
+                         "the per-replica healths and fleet counters")
+    ap.add_argument("--fleet-roles", choices=["colocated", "disagg"],
+                    default="colocated",
+                    help="with --fleet: 'disagg' splits prefill/decode "
+                         "roles and ships KV blocks at the phase flip")
     args = ap.parse_args()
 
     # device env before any jax import (the dtg-lint pattern)
@@ -78,12 +87,26 @@ def main() -> None:
     params = Transformer(cfg).init(
         jax.random.PRNGKey(args.seed),
         jnp.zeros((1, 8), jnp.int32))["params"]
-    eng = ServeEngine(cfg, params, slots=args.slots,
-                      num_blocks=args.num_blocks,
-                      block_size=args.block_size,
-                      prefill_chunk=args.prefill_chunk,
-                      temperature=args.temperature, top_k=args.top_k,
-                      prefix_cache=args.prefix_cache, adapters=bank)
+    if args.fleet:
+        from distributed_tensorflow_guide_tpu.serve.fleet import (
+            FleetScheduler,
+        )
+
+        eng = FleetScheduler(cfg, params, replicas=args.fleet,
+                             roles=args.fleet_roles, slots=args.slots,
+                             num_blocks=args.num_blocks,
+                             block_size=args.block_size,
+                             prefill_chunk=args.prefill_chunk,
+                             temperature=args.temperature,
+                             top_k=args.top_k, adapters=bank,
+                             prefix_cache=args.prefix_cache)
+    else:
+        eng = ServeEngine(cfg, params, slots=args.slots,
+                          num_blocks=args.num_blocks,
+                          block_size=args.block_size,
+                          prefill_chunk=args.prefill_chunk,
+                          temperature=args.temperature, top_k=args.top_k,
+                          prefix_cache=args.prefix_cache, adapters=bank)
     rng = np.random.RandomState(args.seed)
     sys_prompt = (rng.randint(0, cfg.vocab_size, 16).astype(np.int32)
                   if args.prefix_cache else None)
@@ -108,9 +131,14 @@ def main() -> None:
     print("--")
     for rid, toks in sorted(eng.completions().items()):
         print(f"req {rid}: {toks}")
-    print(f"steps={eng.steps} health={eng.health()}")
-    # shutdown contract: every block accounted for, loudly
-    eng.sched.pool.check_leaks()
+    if args.fleet:
+        print(f"health={eng.health()}")
+        # shutdown contract: every replica's ledgers clean, loudly
+        eng.check_leaks()
+    else:
+        print(f"steps={eng.steps} health={eng.health()}")
+        # shutdown contract: every block accounted for, loudly
+        eng.sched.pool.check_leaks()
     eng.close()
     print("pool.check_leaks(): clean")
 

@@ -535,6 +535,39 @@ class ServeEngine:
                 for i in range(len(payloads[0]))]
         self.pool = _pool_scatter(self.pool, idx, rows)
 
+    # ---- fleet tier: stream export / adoption (PR 18) --------------------
+
+    def export_stream(self, rid: int, *, with_kv: bool = True) -> dict:
+        """Detach a live stream into a portable migration record for
+        another replica's :meth:`adopt_stream`.  ``with_kv=True`` d2h-
+        copies the stream's written KV blocks (one fused gather for the
+        whole tree) BEFORE the scheduler frees them, so a decode-phase
+        stream resumes at the target by swap-in instead of re-prefill;
+        ``with_kv=False`` ships the continuation alone (the target
+        re-prefills — same stream bitwise either way, by the position-
+        derived sampling keys).  The record's ``payload_bytes`` is what
+        the fleet charges against the DCN roofline."""
+        keep = self.sched.migratable_blocks(rid) if with_kv else []
+        payloads = self._cache_d2h_many(keep) if keep else []
+        record = self.sched.detach_stream(rid)
+        record["payloads"] = payloads
+        record["payload_bytes"] = sum(
+            int(a.nbytes) for p in payloads for a in p)
+        return record
+
+    def adopt_stream(self, record: dict) -> None:
+        """Adopt a migrated stream exported by another replica.  KV
+        payloads land in THIS engine's host spill store (the adoption
+        landing pad) and the stream resumes by the normal swap-in path
+        at its next admission — ``submitted`` is never recounted (the
+        scheduler's attach bypasses submit by contract)."""
+        if record.get("payloads") and self.store is None:
+            raise RuntimeError(
+                "adopting KV payloads needs ServeEngine(host_blocks>0) "
+                "(the adoption landing pad); export with_kv=False to "
+                "re-prefill instead")
+        self.sched.attach_stream(record)
+
     # ---- the tick --------------------------------------------------------
 
     def step(self, now: float = 0.0) -> tuple[list[Event], str]:
@@ -844,6 +877,8 @@ class ServeEngine:
             "spill_prefetched_blocks": sd.spill_prefetched_blocks,
             "spill_resumes": sd.spill_resumes,
             "swapin_tokens_saved": sd.swapin_tokens_saved,
+            "migrated_out": sd.migrated_out,
+            "migrated_in": sd.migrated_in,
             "host_blocks": (self.store.live_blocks()
                             if self.store is not None else 0),
             "host_bytes": (self.store.bytes_stored()

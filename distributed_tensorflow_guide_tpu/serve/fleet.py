@@ -1,0 +1,689 @@
+"""Fleet tier: global scheduling over a shard of per-replica engines.
+
+A :class:`FleetScheduler` owns what must be GLOBAL for a scaled-out
+server — admission (one door, one queue-depth gate), per-tenant
+deficit-round-robin and quotas (fair-share holds fleet-wide, not
+per-replica), and request->replica routing — while each replica stays a
+stock :class:`~.engine.ServeEngine` running the SAME two jitted serve
+programs over its own DP×TP mesh.  Replicas are built with identical
+geometry, so the fleet compiles nothing the single-engine path didn't:
+``build_step_fns`` memoizes on config+geometry, and every golden
+fingerprint survives byte-identical with the fleet knob off.
+
+Three placement policies compose here:
+
+* **Disaggregated prefill/decode** (``roles="disagg"``): prefill-role
+  replicas run chunked prefill only; the moment a stream turns
+  decode-phase its written KV blocks are exported (one fused d2h
+  gather), shipped as a migration record, and adopted by a decode-role
+  replica's host spill store, where the normal swap-in path resumes it.
+  Prefill is compute-bound and decode is bandwidth-bound — splitting
+  the roles stops each from starving the other's resource.  The
+  transfer is counted (``migration_bytes``/``migration_secs``) so the
+  bench can price it against ``device_dcn_peak`` and reconcile with
+  ``obs/recon``; the compiled-side model is the
+  ``serve_kv_block_transfer_dcn`` program in ``parallel/multislice.py``.
+* **Fleet-level prefix routing** (``prefix_routing=True``): a request
+  routes to the replica already holding its longest cached prefix
+  (probed against each candidate's radix trie) before falling back to
+  least-loaded, so prefix locality concentrates instead of diluting
+  across the fleet.
+* **Elastic capacity** (``world_chaos=``): ``slice_loss`` /
+  ``slice_return`` faults drive replica shed/reabsorb through the
+  placement tier with :class:`~..train.elastic_world.ElasticSupervisor`
+  semantics — a generation counter, a timeline entry per world change,
+  and every live stream of a lost replica RE-ANCHORED (the continuation
+  transform, KV lost with the replica) onto the fleet queue front.  The
+  autoscale signal joins the PR-14 TTFT-EWMA with queue pressure and
+  goodput counters.
+
+Guarantees: every stream — routed anywhere, migrated mid-flight, or
+re-anchored through a replica loss — is bitwise identical to a one-shot
+``make_generate_fn`` run of that request alone (position-derived
+sampling keys; KV migration ships the same bytes the source wrote).
+Per-tenant counters aggregate across replicas as a DISJOINT sum:
+``submitted`` counts once where the stream was first dispatched, the
+terminal status once where it ended, and migration bypasses ``submit``
+by contract.  Non-guarantees: there is no cross-replica event-log
+identity (each replica's flight recorder sees only its own residency),
+and migration is re-anchoring, not replay — the target replica's log
+starts at the adoption, never a replayed history.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from distributed_tensorflow_guide_tpu.obs import events as obs_events
+from distributed_tensorflow_guide_tpu.serve.engine import (
+    EngineOverloaded,
+    Event,
+    Request,
+    ServeEngine,
+)
+
+__all__ = ["FleetScheduler"]
+
+ROLES = ("colocated", "prefill", "decode")
+
+
+@dataclasses.dataclass
+class _Item:
+    """One fleet-queue entry: a fresh request, or a migration record
+    (adoption instead of submission) with a request VIEW of the record
+    for DRR/quota accounting."""
+
+    req: Request
+    record: dict | None = None
+
+
+class FleetScheduler:
+    """Global admission + DRR + routing over N ServeEngine replicas.
+
+    >>> fleet = FleetScheduler(cfg, params, replicas=2, slots=4,
+    ...                        num_blocks=33, block_size=8,
+    ...                        prefill_chunk=16)
+    >>> fleet.submit(Request(rid=0, prompt=toks, max_new_tokens=16,
+    ...                      rng=jax.random.PRNGKey(0)))
+    >>> fleet.run()
+    >>> fleet.completions()[0]
+    """
+
+    def __init__(self, cfg, params, *, replicas: int = 2,
+                 roles="colocated",
+                 slots: int, num_blocks: int, block_size: int,
+                 prefill_chunk: int,
+                 temperature: float = 0.0, top_k: int | None = None,
+                 adapters=None,
+                 max_queue: int | None = None,
+                 tenant_quotas=None, drr_quantum: int | None = None,
+                 prefix_cache: bool = False,
+                 prefix_routing: bool | None = None,
+                 host_blocks: int = 0,
+                 chaos=None, world_chaos=None,
+                 burst_factory=None, recorder=None) -> None:
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if roles == "colocated":
+            role_list = ["colocated"] * replicas
+        elif roles == "disagg":
+            if replicas < 2:
+                raise ValueError(
+                    "disagg needs >= 2 replicas (one per role)")
+            # alternate so any fleet width gets both roles; prefill first
+            role_list = ["prefill" if i % 2 == 0 else "decode"
+                         for i in range(replicas)]
+        else:
+            role_list = [str(r) for r in roles]
+            if len(role_list) != replicas:
+                raise ValueError(
+                    f"roles length {len(role_list)} != replicas "
+                    f"{replicas}")
+            for r in role_list:
+                if r not in ROLES:
+                    raise ValueError(f"unknown role {r!r}")
+        if ("decode" in role_list) != ("prefill" in role_list):
+            raise ValueError(
+                "prefill and decode roles come as a pair — a role split "
+                "with only one side cannot serve")
+        self.roles = role_list
+        self.disagg = "prefill" in role_list
+        self.prefix_routing = (prefix_cache if prefix_routing is None
+                               else bool(prefix_routing))
+        if self.prefix_routing and not prefix_cache:
+            raise ValueError(
+                "prefix_routing needs prefix_cache=True (the per-replica "
+                "tries are what routing probes)")
+        chaos_list = (chaos if isinstance(chaos, (list, tuple))
+                      else [chaos] * replicas)
+        if len(chaos_list) != replicas:
+            raise ValueError(
+                f"chaos list length {len(chaos_list)} != replicas "
+                f"{replicas}")
+        self.rec = (recorder if recorder is not None
+                    else obs_events.current())
+        self.engines: list[ServeEngine] = []
+        for i, role in enumerate(role_list):
+            # adoptable replicas get a host-store landing pad at least
+            # one full pool deep: migrated KV blocks arrive THERE and
+            # resume by the normal swap-in path.  Replica-level quotas
+            # and queue gates are OFF — fair-share and the door gate are
+            # fleet-global by design.
+            hb = host_blocks
+            if self.disagg and role != "prefill":
+                hb = max(host_blocks, num_blocks)
+            self.engines.append(ServeEngine(
+                cfg, params, slots=slots, num_blocks=num_blocks,
+                block_size=block_size, prefill_chunk=prefill_chunk,
+                temperature=temperature, top_k=top_k,
+                adapters=adapters,
+                max_queue=None, chaos=chaos_list[i],
+                burst_factory=burst_factory,
+                prefix_cache=prefix_cache, host_blocks=hb,
+                tenant_quotas=None, drr_quantum=None,
+                recorder=recorder))
+        self.num_slots = slots
+        self.block_size = block_size
+        self.max_queue = max_queue
+        self.tenant_quotas = {int(t): dict(q) for t, q in
+                              (tenant_quotas or {}).items()}
+        sched0 = self.engines[0].sched
+        self.drr_quantum = (sched0.blocks_per_seq if drr_quantum is None
+                            else int(drr_quantum))
+        if self.drr_quantum < 1:
+            raise ValueError(
+                f"drr_quantum must be >= 1, got {self.drr_quantum}")
+        self._deficit: dict[int, int] = {}
+        self.queue: list[_Item] = []
+        self.world = world_chaos
+        self._live: set[int] = set(range(replicas))
+        self._tick = 0
+        # fleet counters (the bench's DCN reconciliation inputs live
+        # here; serve/ never imports benchmarks/)
+        self.shed = 0
+        self.migrations = 0
+        self.migration_bytes = 0
+        self.migration_secs = 0.0
+        self.migrated_rids: list[int] = []
+        self.prefix_route_hits = 0
+        self.prefix_route_hit_tokens = 0
+        self.generation = 0
+        self.replicas_shed = 0
+        self.replicas_regrown = 0
+        self.timeline: list[dict] = []
+        self._fleet_tenants: dict[int, dict[str, int]] = {}
+
+    # ---- intake ----------------------------------------------------------
+
+    def _ft(self, tenant: int) -> dict[str, int]:
+        return self._fleet_tenants.setdefault(int(tenant), {"shed": 0})
+
+    def submit(self, req: Request) -> None:
+        """The fleet door: cheap validation plus the GLOBAL queue-depth
+        gate (replicas run ungated).  Nothing is recorded for a shed
+        request — :class:`EngineOverloaded` stays retriable."""
+        cfg = self.engines[0].fns.cfg
+        sched0 = self.engines[0].sched
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if int(prompt.max()) >= cfg.vocab_size:
+            raise ValueError("prompt token out of vocabulary")
+        if req.tenant < 0:
+            raise ValueError(f"tenant must be >= 0, got {req.tenant}")
+        if prompt.size + req.max_new_tokens > cfg.max_len:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new {req.max_new_tokens} "
+                f"exceeds max_len {cfg.max_len}")
+        need = sched0.max_request_blocks(prompt.size, req.max_new_tokens)
+        if need > sched0.pool.capacity:
+            raise ValueError(
+                f"request {req.rid} can never fit: needs {need} blocks, "
+                f"pool capacity {sched0.pool.capacity}")
+        quota = self.tenant_quotas.get(int(req.tenant), {})
+        if quota.get("blocks") is not None and need > quota["blocks"]:
+            raise ValueError(
+                f"request {req.rid} can never fit tenant {req.tenant}'s "
+                f"block quota: needs {need}, quota {quota['blocks']}")
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            self.shed += 1
+            self._ft(req.tenant)["shed"] += 1
+            if self.rec.enabled:
+                self.rec.emit(
+                    "req.shed", cat="serve", actor="fleet",
+                    payload={"rid": req.rid, "reason": "queue_depth",
+                             "tenant": int(req.tenant),
+                             "queue_depth": len(self.queue)},
+                    t=float(req.arrival))
+            raise EngineOverloaded(
+                f"request {req.rid} shed: fleet queue depth "
+                f"{len(self.queue)} at the max_queue={self.max_queue} "
+                "gate — retry later")
+        self.queue.append(_Item(req=dataclasses.replace(
+            req, prompt=prompt, rng=np.asarray(req.rng, np.uint32))))
+
+    def cancel(self, rid: int) -> bool:
+        """Client abandon, fleet-wide: drop a fleet-queued item outright,
+        or forward to whichever replica holds the stream."""
+        for j, item in enumerate(self.queue):
+            if item.req.rid == rid:
+                self.queue.pop(j)
+                return True
+        return any(self.engines[i].cancel(rid)
+                   for i in sorted(self._live))
+
+    # ---- global DRR dispatch ---------------------------------------------
+
+    def _tenant_heads(self) -> list[tuple[_Item, int]]:
+        heads: list[tuple[_Item, int]] = []
+        seen: set[int] = set()
+        for item in self.queue:
+            t = int(item.req.tenant)
+            if t not in seen:
+                seen.add(t)
+                heads.append((item, t))
+        return heads
+
+    def _load(self, i: int) -> int:
+        sd = self.engines[i].sched
+        return sum(s is not None for s in sd.slots) + len(sd.queue)
+
+    def _store_room(self, i: int) -> int:
+        st = self.engines[i].store
+        if st is None:
+            return 0
+        if st.capacity is None:
+            return 1 << 30
+        return st.capacity - st.live_blocks()
+
+    def _quota_allows(self, tenant: int, req: Request) -> bool:
+        """Fleet-wide committed usage: worst-case footprints of the
+        tenant's residents AND replica-queued requests across every live
+        replica — dispatch is the commitment point, so the global quota
+        can never be overrun by replicas admitting independently."""
+        quota = self.tenant_quotas.get(int(tenant))
+        if not quota:
+            return True
+        slots_used = 0
+        committed = 0
+        for i in sorted(self._live):
+            sd = self.engines[i].sched
+            for s in sd.slots:
+                if s is not None and s.tenant == tenant:
+                    slots_used += 1
+                    committed += s.max_blocks
+            for r in sd.queue:
+                if int(r.tenant) == tenant:
+                    slots_used += 1
+                    committed += sd.max_request_blocks(
+                        len(r.prompt), r.max_new_tokens)
+        if (quota.get("slots") is not None
+                and slots_used >= quota["slots"]):
+            return False
+        if quota.get("blocks") is not None:
+            cost = self.engines[0].sched.max_request_blocks(
+                len(req.prompt), req.max_new_tokens)
+            if committed + cost > quota["blocks"]:
+                return False
+        return True
+
+    def _route(self, item: _Item) -> int | None:
+        """The routing policy, in preference order: (1) a KV-carrying
+        migration record goes to the least-loaded adoptable replica with
+        store room; (2) a re-prefill item probes the prefix tries and
+        goes to the longest cached prefix when routing is on; (3)
+        least-loaded wins, lowest index breaking ties.  Only replicas
+        with a free-ish slot budget (load < slots) are candidates — the
+        fleet queue, not replica queues, is where work waits, which is
+        what keeps the global DRR in charge."""
+        rec = item.record
+        payloads = (rec or {}).get("payloads") or []
+        if payloads:
+            cands = [i for i in sorted(self._live)
+                     if self.roles[i] != "prefill"
+                     and self.engines[i].store is not None
+                     and self._store_room(i) >= len(payloads)
+                     and self._load(i) < self.engines[i].num_slots]
+            if not cands:
+                return None
+            return min(cands, key=lambda i: (self._load(i), i))
+        if self.disagg:
+            cands = [i for i in sorted(self._live)
+                     if self.roles[i] == "prefill"]
+            if not cands:  # every prefill replica shed: degrade, not die
+                cands = sorted(self._live)
+        else:
+            cands = sorted(self._live)
+        cands = [i for i in cands
+                 if self._load(i) < self.engines[i].num_slots]
+        if not cands:
+            return None
+        if self.prefix_routing:
+            best, hit = None, 0
+            for i in cands:
+                sd = self.engines[i].sched
+                if sd.prefix is None:
+                    continue
+                n = len(sd.prefix.match_nodes(
+                    item.req.prompt, adapter=int(item.req.adapter)))
+                if n > hit:
+                    best, hit = i, n
+            if best is not None and hit > 0:
+                self.prefix_route_hits += 1
+                self.prefix_route_hit_tokens += hit * self.block_size
+                if self.rec.enabled:
+                    self.rec.emit(
+                        "fleet.prefix_route", cat="serve", actor="fleet",
+                        payload={"rid": item.req.rid, "replica": best,
+                                 "hit_tokens": hit * self.block_size})
+                return best
+        return min(cands, key=lambda i: (self._load(i), i))
+
+    def _dispatch(self, now: float) -> int:
+        """Global deficit-round-robin over per-tenant fleet-queue heads —
+        the same loop shape as :meth:`Scheduler.admit`, with "a replica
+        accepted it" in place of "blocks were found".  Migration records
+        dispatch through ``adopt_stream`` (never re-counting
+        ``submitted``); fresh requests through the replica's ``submit``,
+        whose predicted-TTFT gate may still shed (counted there, exactly
+        as a single engine would have)."""
+        sched0 = self.engines[0].sched
+        dispatched = 0
+        while self.queue:
+            progressed = False
+            deficit_waiting = False
+            for item, tenant in self._tenant_heads():
+                if item.req.arrival > now:
+                    continue
+                if not self._quota_allows(tenant, item.req):
+                    continue
+                cost = sched0.max_request_blocks(
+                    len(item.req.prompt), item.req.max_new_tokens)
+                self._deficit[tenant] = (self._deficit.get(tenant, 0)
+                                         + self.drr_quantum)
+                if self._deficit[tenant] < cost:
+                    deficit_waiting = True
+                    continue
+                target = self._route(item)
+                if target is None:
+                    continue
+                self.queue.pop(next(
+                    j for j, it in enumerate(self.queue) if it is item))
+                eng = self.engines[target]
+                if item.record is not None:
+                    eng.adopt_stream(item.record)
+                else:
+                    try:
+                        eng.submit(item.req)
+                    except EngineOverloaded:
+                        pass  # TTFT-gate shed, counted by the replica
+                self._deficit[tenant] -= cost
+                dispatched += 1
+                progressed = True
+            if not progressed and not deficit_waiting:
+                break
+        queued = {int(it.req.tenant) for it in self.queue}
+        for t in [t for t in self._deficit if t not in queued]:
+            del self._deficit[t]
+        return dispatched
+
+    # ---- disaggregation: prefill -> decode migration ---------------------
+
+    def _migrate_prefilled(self, now: float) -> int:
+        """Ship every stream that just turned decode-phase on a
+        prefill-role replica to a decode-role replica: fused d2h export
+        of its written KV blocks, re-anchored through the fleet queue
+        FRONT (adopted next tick by the normal swap-in path).  When no
+        decode replica has store room the stream simply keeps decoding
+        where it is — degraded placement, never a dropped stream."""
+        moved = 0
+        for i in sorted(self._live):
+            if self.roles[i] != "prefill":
+                continue
+            eng = self.engines[i]
+            ready = sorted(
+                (s for s in eng.sched.slots
+                 if s is not None and s.phase == "decode"
+                 and s.written >= 1 and s.budget > 0),
+                key=lambda s: s.admitted_seq)
+            for s in ready:
+                n_blocks = len(eng.sched.migratable_blocks(s.rid))
+                if not n_blocks:
+                    continue
+                has_target = any(
+                    self.roles[j] != "prefill"
+                    and self.engines[j].store is not None
+                    and self._store_room(j) >= n_blocks
+                    for j in self._live if j != i)
+                if not has_target:
+                    continue
+                t0 = time.perf_counter()
+                record = eng.export_stream(s.rid, with_kv=True)
+                self.migration_secs += time.perf_counter() - t0
+                self.migrations += 1
+                self.migration_bytes += int(record["payload_bytes"])
+                self.migrated_rids.append(int(record["rid"]))
+                self.queue.insert(
+                    0, _Item(req=self._record_req(record), record=record))
+                moved += 1
+                if self.rec.enabled:
+                    self.rec.emit(
+                        "fleet.migrate", cat="serve", actor="fleet",
+                        payload={"rid": int(record["rid"]),
+                                 "from": i, "blocks": n_blocks,
+                                 "bytes": int(record["payload_bytes"])},
+                        t=now)
+        return moved
+
+    @staticmethod
+    def _record_req(record: dict) -> Request:
+        return Request(
+            rid=int(record["rid"]),
+            prompt=np.asarray(record["prompt"], np.int32),
+            max_new_tokens=int(record["budget"]),
+            rng=np.asarray(record["rng"], np.uint32),
+            arrival=float(record.get("arrival", float("-inf"))),
+            tenant=int(record.get("tenant", 0)),
+            adapter=int(record.get("adapter", 0)))
+
+    # ---- elastic capacity: replica shed / reabsorb -----------------------
+
+    def _apply_world(self, tick: int, now: float) -> None:
+        if self.world is None:
+            return
+        due = [f for f in self.world.world_events() if f.position <= tick]
+        for f in due:
+            self.world.fire(f)
+            idx = f.slice_id % len(self.engines)
+            if f.kind == "slice_loss":
+                if idx in self._live and len(self._live) > 1:
+                    self._shed_replica(idx)
+                    self.replicas_shed += 1
+            elif f.kind == "slice_return":
+                if idx not in self._live:
+                    self._live.add(idx)
+                    self.replicas_regrown += 1
+            self.generation += 1
+            self.timeline.append({
+                "generation": self.generation, "tick": tick,
+                "kind": f.kind, "replica": idx,
+                "live": sorted(self._live),
+                "signal": self.autoscale_signal()})
+            if self.rec.enabled:
+                self.rec.emit(
+                    "fleet.world", cat="serve", actor="fleet",
+                    payload={"kind": f.kind, "replica": idx,
+                             "generation": self.generation,
+                             "live": sorted(self._live)},
+                    t=now)
+
+    def _shed_replica(self, idx: int) -> None:
+        """A lost replica's live streams re-anchor on the fleet queue
+        FRONT in admission-then-queue order (the ``snapshot_state``
+        convention): the continuation transform with the KV lost along
+        with the replica, so each re-prefills elsewhere and continues
+        bitwise.  The engine OBJECT is retained for accounting —
+        completed streams and tenant counters persist supervisor-side,
+        exactly like a training generation's report outliving its
+        processes — and comes back cold (trie and spill store dropped)
+        if a ``slice_return`` reabsorbs it."""
+        eng = self.engines[idx]
+        sd = eng.sched
+        live = sorted((s for s in sd.slots if s is not None),
+                      key=lambda s: s.admitted_seq)
+        rids = [s.rid for s in live] + [r.rid for r in sd.queue]
+        items = []
+        for rid in rids:
+            record = eng.export_stream(rid, with_kv=False)
+            items.append(_Item(req=self._record_req(record),
+                               record=record))
+        self.queue[:0] = items
+        sd.release_prefix_cache()
+        if eng.store is not None:
+            sd.release_spill_store()
+        self._live.discard(idx)
+
+    def autoscale_signal(self) -> dict:
+        """What an autoscaler would act on: global queue pressure
+        against live capacity, the worst live replica's TTFT-EWMA (the
+        PR-14 shed-gate statistic), and cumulative goodput tokens."""
+        live = sorted(self._live)
+        queued = len(self.queue) + sum(
+            len(self.engines[i].sched.queue) for i in live)
+        capacity = max(1, len(live) * self.num_slots)
+        ewmas = [self.engines[i]._ttft_ewma for i in live
+                 if self.engines[i]._ttft_ewma is not None]
+        goodput = sum(c["tokens"]
+                      for eng in self.engines
+                      for c in eng.sched.tenants.values())
+        pressure = queued / capacity
+        return {
+            "queued": queued,
+            "live_replicas": len(live),
+            "total_replicas": len(self.engines),
+            "pressure": pressure,
+            "ttft_ewma_s": max(ewmas) if ewmas else None,
+            "goodput_tokens": goodput,
+            "want_more_replicas": bool(
+                pressure > 1.0 or len(live) < len(self.engines)),
+        }
+
+    # ---- the fleet tick --------------------------------------------------
+
+    def step(self, now: float = 0.0) -> tuple[list[Event], str]:
+        """One fleet tick: apply due world faults, run the global DRR
+        dispatch, step every live replica once, then migrate any
+        freshly-prefilled streams off prefill-role replicas.  Returns
+        (events, kind) with kind in {"busy", "idle"} — replica ticks,
+        dispatches and migrations all count as progress."""
+        tick = self._tick
+        self._tick += 1
+        self._apply_world(tick, now)
+        dispatched = self._dispatch(now)
+        events: list[Event] = []
+        busy = dispatched > 0
+        # per-replica wall seconds of THIS tick: replicas are independent
+        # machines, so a virtual-clock driver should charge the slowest
+        # replica (plus the supervisor's own overhead), not the sum the
+        # in-process serial loop happens to pay
+        self.step_secs: dict[int, float] = {}
+        for i in sorted(self._live):
+            t0 = time.perf_counter()
+            evs, kind = self.engines[i].step(now)
+            self.step_secs[i] = time.perf_counter() - t0
+            events.extend(evs)
+            busy = busy or kind != "idle"
+        if self.disagg:
+            busy = bool(self._migrate_prefilled(now)) or busy
+        return events, ("busy" if busy else "idle")
+
+    def next_arrival(self) -> float | None:
+        """Earliest future arrival anywhere in the fleet — the virtual
+        clock's fast-forward target when a tick comes back idle.
+        Re-anchored migration records (arrival ``-inf``) never gate."""
+        cands = [it.req.arrival for it in self.queue
+                 if it.req.arrival != float("-inf")]
+        for i in sorted(self._live):
+            nxt = self.engines[i].sched.next_arrival()
+            if nxt is not None:
+                cands.append(nxt)
+        return min(cands) if cands else None
+
+    def _has_work(self) -> bool:
+        return bool(self.queue) or any(
+            self.engines[i].sched.has_queued
+            or self.engines[i].sched.has_resident
+            for i in sorted(self._live))
+
+    def run(self, max_ticks: int | None = None) -> list[Event]:
+        """Drain all submitted work on the tick clock.  Idle ticks are
+        tolerated in bounded runs of them (chaos pressure holds and
+        pending world returns resolve by tick), then declared a
+        deadlock."""
+        events: list[Event] = []
+        ticks = 0
+        stalled = 0
+        while self._has_work():
+            evs, kind = self.step(now=float("inf"))
+            events.extend(evs)
+            stalled = 0 if kind != "idle" else stalled + 1
+            if stalled > 64:
+                raise RuntimeError(
+                    "fleet deadlock: work queued but no replica "
+                    "progressing")
+            ticks += 1
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+        for i in sorted(self._live):
+            self.engines[i]._release_pressure(float("inf"))
+        return events
+
+    # ---- introspection ---------------------------------------------------
+
+    def completions(self) -> dict[int, list[int]]:
+        """rid -> emitted tokens, merged across replicas.  Disjoint by
+        construction: a stream's emitted list TRAVELS with it (popped at
+        detach, installed at attach), so a rid appearing on two replicas
+        is a conservation bug worth crashing on."""
+        out: dict[int, list[int]] = {}
+        for eng in self.engines:
+            for rid, toks in eng.completions().items():
+                if rid in out:
+                    raise AssertionError(
+                        f"rid {rid} emitted on two replicas — the "
+                        "migration seam double-counted a stream")
+                out[rid] = toks
+        return out
+
+    def health(self) -> dict:
+        """Fleet health: per-replica engine healths plus the GLOBAL
+        view — element-wise per-tenant aggregation across every replica
+        (migration makes this a disjoint sum: submitted once at the
+        dispatch replica, terminal status once where the stream ended)
+        merged with fleet-door sheds, and the fleet counters."""
+        tenants: dict[int, dict[str, int]] = {}
+        for eng in self.engines:
+            for t, c in eng.sched.tenants.items():
+                agg = tenants.setdefault(int(t), {})
+                for k, v in c.items():
+                    agg[k] = agg.get(k, 0) + int(v)
+        for t, c in self._fleet_tenants.items():
+            agg = tenants.setdefault(int(t), {})
+            for k, v in c.items():
+                agg[k] = agg.get(k, 0) + int(v)
+        replicas = []
+        for i, eng in enumerate(self.engines):
+            h = eng.health()
+            h["role"] = self.roles[i]
+            h["live"] = i in self._live
+            replicas.append(h)
+        return {
+            "replicas": replicas,
+            "tenants": {t: dict(c) for t, c in sorted(tenants.items())},
+            "queued": len(self.queue),
+            "shed": self.shed + sum(h["shed"] for h in replicas),
+            "live_replicas": len(self._live),
+            "generation": self.generation,
+            "replicas_shed": self.replicas_shed,
+            "replicas_regrown": self.replicas_regrown,
+            "migrations": self.migrations,
+            "migration_bytes": self.migration_bytes,
+            "migration_secs": self.migration_secs,
+            "prefix_route_hits": self.prefix_route_hits,
+            "prefix_route_hit_tokens": self.prefix_route_hit_tokens,
+            "completed": sum(h["completed"] for h in replicas),
+        }
+
+    def check_leaks(self) -> None:
+        """Joint ledger audit across every replica's pool AND host
+        store — shed replicas included (they must have released
+        everything on the way out)."""
+        for eng in self.engines:
+            eng.sched.check_leaks()
+
+    def close(self) -> None:
+        for eng in self.engines:
+            eng.close()

@@ -232,6 +232,14 @@ class Scheduler:
         self.spill_prefetched_blocks = 0
         self.spill_resumes = 0
         self.swapin_tokens_saved = 0
+        # fleet tier (PR 18): streams detached to / adopted from another
+        # replica's scheduler.  A migrated-out request counts as
+        # ``preempted`` for its tenant (migration IS the ``_preempt``
+        # continuation transform, applied cross-replica); ``submitted``
+        # is never re-counted on adoption — that is the conservation
+        # contract the fleet's aggregated ``health()["tenants"]`` pins.
+        self.migrated_out = 0
+        self.migrated_in = 0
         # observability (PR 14): observe-only. The engine passes its
         # recorder so both sides share one event stream, and refreshes
         # ``now`` (the semantic clock) at the top of every tick.
@@ -975,6 +983,164 @@ class Scheduler:
                                    "spilled": spilled,
                                    "tenant": slot.tenant}, t=self.now)
 
+    # ---- fleet tier: stream migration (PR 18) ----------------------------
+
+    def migratable_blocks(self, rid: int) -> list[int]:
+        """Device blocks whose contents must travel for ``rid`` to resume
+        by swap-in on another replica: the WRITTEN blocks of a resident
+        decode-phase slot, in position order.  Empty for mid-prefill
+        residents and queued requests — their continuation re-prefills
+        at the target, which lands on the same stream bitwise anyway
+        (position-derived sampling keys)."""
+        for s in self.slots:
+            if s is not None and s.rid == rid:
+                if s.phase != DECODE or s.written < 1:
+                    return []
+                return list(s.blocks[:blocks_for(s.written,
+                                                 self.block_size)])
+        return []
+
+    def detach_stream(self, rid: int) -> dict:
+        """Detach a live request into a portable migration record — the
+        ``_preempt`` continuation transform, except the continuation
+        leaves this scheduler entirely instead of re-queueing here.
+        Every local hold is released (pool blocks; a queued spilled
+        continuation drops its spill record — the target re-prefills);
+        the record carries everything :meth:`attach_stream` needs to
+        continue the stream bitwise elsewhere.  Emitted tokens and
+        lifecycle meta TRAVEL with the stream (popped here, installed
+        there), so fleet-aggregated per-tenant counters stay a disjoint
+        sum: ``submitted`` counted once at the source, the terminal
+        status once at wherever the stream finishes.  KV payloads do NOT
+        travel here — the engine d2h-copies :meth:`migratable_blocks`
+        BEFORE calling this and attaches them to the returned record.
+        Raises KeyError for unknown or terminal rids."""
+        if rid in self.finished:
+            raise KeyError(
+                f"rid {rid} is terminal ({self.finished[rid]}); "
+                "only live streams migrate")
+        record: dict | None = None
+        for i, s in enumerate(self.slots):
+            if s is not None and s.rid == rid:
+                cont_prompt = s.prompt
+                if s.emitted_here:
+                    tail = self.emitted[rid][-s.emitted_here:]
+                    cont_prompt = np.concatenate(
+                        [s.prompt, np.asarray(tail, np.int32)])
+                self.pool.free(rid, s.blocks)
+                self.slots[i] = None
+                # migration IS preemption from this tenant's viewpoint:
+                # the residency ended before the budget was spent
+                self._tc(s.tenant)["preempted"] += 1
+                record = {
+                    "rid": rid, "prompt": cont_prompt,
+                    "budget": int(s.budget), "rng": s.rng,
+                    "arrival": float("-inf"),  # already served once
+                    "tenant": int(s.tenant), "adapter": int(s.adapter),
+                    "written": int(s.written) if s.phase == DECODE else 0,
+                    "pending": int(s.pending) if s.phase == DECODE else 0,
+                }
+                break
+        if record is None:
+            for j, r in enumerate(self.queue):
+                if r.rid == rid:
+                    if self.store is not None:
+                        self._drop_spill_record(rid)
+                    self.queue.pop(j)
+                    record = {
+                        "rid": rid,
+                        "prompt": np.asarray(r.prompt, np.int32),
+                        "budget": int(r.max_new_tokens), "rng": r.rng,
+                        "arrival": float(r.arrival),
+                        "tenant": int(r.tenant),
+                        "adapter": int(r.adapter),
+                        "written": 0, "pending": 0,
+                    }
+                    break
+        if record is None:
+            raise KeyError(f"rid {rid} not live on this scheduler")
+        record["emitted"] = list(self.emitted.pop(rid, []))
+        record["first_emit"] = bool(self.first_emit.pop(rid, False))
+        m = self.meta.pop(rid, None)
+        record["meta"] = None if m is None else [m[0], m[1], m[2]]
+        self.tenant_of.pop(rid, None)
+        record["payloads"] = []
+        record["payload_bytes"] = 0
+        self.migrated_out += 1
+        if self.rec.enabled:
+            self.rec.emit("req.migrate_out", cat="serve",
+                          actor="scheduler",
+                          payload={"rid": rid,
+                                   "written": int(record["written"]),
+                                   "tenant": int(record["tenant"])},
+                          t=self.now)
+        return record
+
+    def attach_stream(self, record: dict) -> None:
+        """Adopt a migrated stream: install its identity maps and queue
+        the continuation at the FRONT (it was already served elsewhere).
+        KV payloads (if any) are banked into the host spill store as a
+        spill record, so admission resumes the stream by swap-in — the
+        same bytes the source replica wrote, which is why the continued
+        stream is bitwise the uninterrupted one.  All-or-nothing: a full
+        store rolls back every put and raises RuntimeError with no state
+        change.  Deliberately bypasses :meth:`submit` — ``submitted``
+        was counted at the source and must never recount here (the
+        fleet-aggregation conservation pin)."""
+        rid = int(record["rid"])
+        if (rid in self.finished
+                or any(s is not None and s.rid == rid
+                       for s in self.slots)
+                or any(r.rid == rid for r in self.queue)):
+            raise ValueError(
+                f"rid {rid} already live or terminal on this scheduler")
+        payloads = record.get("payloads") or []
+        if payloads:
+            if self.store is None:
+                raise RuntimeError(
+                    "adopting KV payloads needs a host spill store "
+                    "(attach landing pad); re-export without KV to "
+                    "re-prefill instead")
+            hs: list[int] = []
+            for p in payloads:
+                h = self.store.put(rid, p)
+                if h is None:
+                    self.store.free(rid, hs)
+                    raise RuntimeError(
+                        f"host store full adopting rid {rid} "
+                        f"({len(payloads)} KV blocks)")
+                hs.append(h)
+            self._spilled[rid] = {
+                "entries": [("host", h) for h in hs],
+                "written": int(record["written"]),
+                "pending": int(record["pending"]),
+            }
+        self.emitted[rid] = list(record.get("emitted", []))
+        self.first_emit[rid] = bool(record.get("first_emit", False))
+        self.tenant_of[rid] = int(record.get("tenant", 0))
+        m = record.get("meta")
+        if m is not None:
+            self.meta[rid] = (
+                float(m[0]),
+                None if m[1] is None else float(m[1]),
+                None if m[2] is None else float(m[2]))
+        self.queue.insert(0, Request(
+            rid=rid, prompt=np.asarray(record["prompt"], np.int32),
+            max_new_tokens=int(record["budget"]),
+            rng=np.asarray(record["rng"], np.uint32),
+            arrival=float(record.get("arrival", float("-inf"))),
+            tenant=int(record.get("tenant", 0)),
+            adapter=int(record.get("adapter", 0))))
+        self.migrated_in += 1
+        if self.rec.enabled:
+            self.rec.emit("req.migrate_in", cat="serve",
+                          actor="scheduler",
+                          payload={"rid": rid,
+                                   "kv_blocks": len(payloads),
+                                   "written": int(record["written"]),
+                                   "tenant": int(record["tenant"])},
+                          t=self.now)
+
     # ---- result application ---------------------------------------------
 
     def prefill_done_chunks(self, slot_idx: int) -> int:
@@ -1171,7 +1337,9 @@ class Scheduler:
                          "spill_prefetched_blocks":
                              self.spill_prefetched_blocks,
                          "spill_resumes": self.spill_resumes,
-                         "swapin_tokens_saved": self.swapin_tokens_saved},
+                         "swapin_tokens_saved": self.swapin_tokens_saved,
+                         "migrated_out": self.migrated_out,
+                         "migrated_in": self.migrated_in},
             "tenant_of": {str(k): int(v)
                           for k, v in self.tenant_of.items()},
             "tenants": {str(k): dict(v)
@@ -1236,6 +1404,8 @@ class Scheduler:
             c.get("spill_prefetched_blocks", 0))
         self.spill_resumes = int(c.get("spill_resumes", 0))
         self.swapin_tokens_saved = int(c.get("swapin_tokens_saved", 0))
+        self.migrated_out = int(c.get("migrated_out", 0))
+        self.migrated_in = int(c.get("migrated_in", 0))
         self.tenant_of = {int(k): int(v)
                           for k, v in snap.get("tenant_of", {}).items()}
         self.tenants = {int(k): {kk: int(vv) for kk, vv in v.items()}
