@@ -36,6 +36,22 @@ def main() -> None:
     ap.add_argument("--seq-len", type=int, default=512)
     ap.add_argument("--global-batch", type=int, default=8)
     ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--dropless", action="store_true",
+                    help="capacity-factor-free router variant (PR 19): "
+                         "the dispatch buffer widens to the local token "
+                         "count so no token is ever dropped — same "
+                         "collective census as top-1 Switch, wider "
+                         "all_to_all payload (the moe_dropless battery "
+                         "row; resolved router echoed)")
+    ap.add_argument("--weight-dtype",
+                    choices=["model", "int8", "int4", "fp8"],
+                    default="model",
+                    help="echoed serving-side expert-bank storage dtype: "
+                         "training always runs full-precision master "
+                         "weights, so this knob only REPORTS the "
+                         "closed-form held-bank byte diet the quantized "
+                         "banks would pay at serve time (bench_serving "
+                         "--moe --weight-dtype measures it live)")
     ap.add_argument("--fused-ce", choices=["auto", "on", "off"],
                     default="auto",
                     help="chunked fused cross-entropy for the LM head "
@@ -67,6 +83,7 @@ def main() -> None:
         causal=True, dtype=dtype,
     )
     lm = SwitchLM(mesh, cfg, args.num_experts, top_k=args.top_k,
+                  router="dropless" if args.dropless else "switch",
                   fused_ce=args.fused_ce)
     params = lm.init_params(jax.random.PRNGKey(0))
     tx = optax.adam(1e-4)
@@ -90,8 +107,20 @@ def main() -> None:
 
     dt, _ = time_steps(step, state, tokens, warmup=3, steps=args.steps)
     toks = args.global_batch * args.seq_len * args.steps
+    # the serving-side expert-bank byte diet the --weight-dtype storage
+    # format would pay per decode step (closed form, echoed — the live
+    # measurement is bench_serving --moe --weight-dtype)
+    bank_elems = args.num_experts * 2 * args.d_model * args.d_ff \
+        * args.layers
+    stored = {"model": np.dtype(dtype).itemsize, "int8": 1,
+              "fp8": 1, "int4": 0.5}[args.weight_dtype]
     report("switch_moe_lm_throughput", toks / dt, "tokens/sec",
-           fused_ce=lm.fused_ce)
+           fused_ce=lm.fused_ce,
+           router=lm.moe_cfg.router,
+           dropless=bool(args.dropless),
+           weight_dtype=args.weight_dtype,
+           expert_bank_bytes=bank_elems * np.dtype(dtype).itemsize,
+           expert_bank_bytes_stored=bank_elems * stored)
 
 
 if __name__ == "__main__":

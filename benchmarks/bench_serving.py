@@ -76,6 +76,18 @@ longest cached prefix, pinned by a repeat wave. Reported:
 ``fleet_goodput_gain`` vs the single engine, the disagg TTFT/TPOT
 split, ``prefix_route_hits`` and the migrated-stream bitwise verdict —
 placement moves COST, never CONTENT.
+
+``--moe E`` adds the MoE A/B phase (PR 19): the model is rebuilt with E
+routed experts at the dense FFN width (top-1 routing = matched ACTIVE
+params per token, E x the held weights) and the top-rate arrival mix
+drives an MoE engine through the same two fixed-slot serve programs.
+A hot expert past ``--moe-capacity`` stalls its extra slots one tick
+each (degrade-to-overflow: goodput bends, tokens never drop or
+corrupt). Reported: MoE-vs-dense goodput at the same offered rate,
+per-expert load/overflow, stall ticks, and the expert all-to-all a
+one-expert-per-device placement would pay — priced forward-only
+(``passes=2``) by the same ``moe_all_to_all_bytes`` closed form the
+training bench pins, reconciled by ``obs/recon``.
 """
 
 import argparse
@@ -181,6 +193,21 @@ def main() -> None:
                     help="fleet-level prefix routing: requests route to "
                          "the replica holding their longest cached "
                          "prefix (turns the per-replica prefix cache on)")
+    ap.add_argument("--moe", type=int, default=0, metavar="E",
+                    help="add the MoE A/B phase (PR 19): rebuild the "
+                         "model with E routed experts at the DENSE FFN "
+                         "width (top-1 routing = matched active params "
+                         "per token), drive the top-rate arrival mix "
+                         "through an MoE engine, and report MoE-vs-dense "
+                         "goodput plus per-expert load/overflow, with "
+                         "the expert all-to-all priced by "
+                         "moe_all_to_all_bytes and reconciled by "
+                         "obs/recon")
+    ap.add_argument("--moe-capacity", type=int, default=0, metavar="C",
+                    help="decode expert capacity per launch (0 = auto: "
+                         "ceil(2*slots/E)); a hot expert past C stalls "
+                         "its extra slots one tick (degrade, never "
+                         "drop)")
     ap.add_argument("--lora-rank", type=int, default=0,
                     help="serve the continuous side multi-LoRA: each "
                          "request decodes under adapter rid %% 4 (0 = "
@@ -232,6 +259,12 @@ def main() -> None:
     if wq and args.lora_rank:
         raise SystemExit("--weight-dtype and --lora-rank are mutually "
                          "exclusive (no f32 kernel for the deltas)")
+    if args.moe and args.lora_rank:
+        raise SystemExit("--moe and --lora-rank are mutually exclusive "
+                         "(no adapter targets in the routed FFN)")
+    if args.moe == 1:
+        raise SystemExit("--moe needs >= 2 experts (1 expert is the "
+                         "dense model)")
     cfg = dataclasses.replace(
         cfg,
         kv_dtype="int8" if args.kv_dtype == "int8" else None,
@@ -1139,6 +1172,103 @@ def main() -> None:
             }
         fl.close()
 
+    # ---- MoE A/B phase (PR 19) -------------------------------------------
+    moe_extras = {}
+    if args.moe:
+        from benchmarks.common import moe_all_to_all_bytes
+        from distributed_tensorflow_guide_tpu.obs import (
+            recon as obs_recon,
+        )
+
+        E = args.moe
+        cap = args.moe_capacity or max(1, -(-2 * args.slots // E))
+        # matched ACTIVE params: every expert is the dense FFN's width
+        # and top-1 routing activates exactly one per token, so the MoE
+        # side pays the dense side's per-token FLOPs while holding E x
+        # the FFN weights — the whole point of the A/B
+        moe_cfg = dataclasses.replace(
+            cfg, weight_dtype=None, moe_experts=E, moe_capacity=cap)
+        moe_params = jax.jit(Transformer(moe_cfg).init)(
+            jax.random.PRNGKey(1),
+            jnp.zeros((1, moe_cfg.max_len), jnp.int32))["params"]
+        if wq:
+            from distributed_tensorflow_guide_tpu.ops import quant
+
+            moe_params = quant.quantize_params(
+                moe_params, bits=8 if wq == "int8" else 4)
+            moe_cfg = dataclasses.replace(moe_cfg, weight_dtype=wq)
+        e_moe = ServeEngine(moe_cfg, moe_params, slots=args.slots,
+                            num_blocks=args.num_blocks,
+                            block_size=args.block_size,
+                            prefill_chunk=args.prefill_chunk,
+                            temperature=0.0)
+        # warm both MoE serve programs outside the clock (the static
+        # side's discipline), then zero the counters the warmup touched
+        # so the reported load/overflow/a2a cover the workload only
+        drive([(70 * 100000 - 1, 0.0,
+                np.zeros(args.prefill_chunk, np.int32), 2)], e_moe)
+        for k in e_moe.steps:
+            e_moe.steps[k] = 0
+        e_moe._moe_load[:] = 0
+        e_moe._moe_overflow[:] = 0
+        e_moe._moe_stall_slot_ticks = e_moe._moe_stall_ticks = 0
+        wl_moe = make_workload(rates[top], args.requests, tag=70)
+        wall0 = time.perf_counter()
+        ev_m, _ = drive(wl_moe, e_moe)
+        moe_secs = time.perf_counter() - wall0
+        lat_m = latencies(ev_m, wl_moe)
+        moe_good = goodput(lat_m, slo_ttft, slo_tpot, wl_moe[0][1])
+        hm = e_moe.health()
+        steps_m = dict(e_moe.steps)
+        e_moe.sched.check_leaks()
+        e_moe.close()
+
+        # the expert all-to-all a one-expert-per-device placement would
+        # pay, priced by the SAME closed form the training bench pins —
+        # forward-only (passes=2), per launch, decode capacity C vs the
+        # prefill chunk's dropless t-wide buffer
+        item = np.dtype(moe_cfg.dtype).itemsize
+        b_dec = E * cap * moe_cfg.d_model * item
+        b_pre = E * args.prefill_chunk * moe_cfg.d_model * item
+        a2a_bytes = (
+            moe_all_to_all_bytes(b_dec, E, moe_cfg.num_layers, passes=2)
+            * steps_m.get("decode", 0)
+            + moe_all_to_all_bytes(b_pre, E, moe_cfg.num_layers,
+                                   passes=2)
+            * steps_m.get("prefill", 0))
+        r = obs_recon.reconcile(
+            {"flops": 0.0, "hbm_bytes": 0.0,
+             "collective_bytes": {"all_to_all[expert]": float(a2a_bytes)}},
+            max(moe_secs, 1e-9), obs_recon.Roofline.from_env())
+        moe_extras = {
+            "moe_experts": E,
+            "moe_capacity": cap,
+            "moe_weight_dtype": args.weight_dtype,
+            "moe_active_params_matched": True,
+            "moe_goodput": round(moe_good, 2),
+            "dense_goodput_at_rate": round(cont_good[top], 2),
+            "moe_vs_dense_goodput": round(
+                moe_good / max(cont_good[top], 1e-9), 3),
+            "moe_ttft_p50": round(float(np.median(
+                [x[0] for x in lat_m])) if lat_m else 0.0, 4),
+            "moe_tpot_p50": round(float(np.median(
+                [x[1] for x in lat_m])) if lat_m else 0.0, 4),
+            "moe_completed": len(lat_m),
+            "moe_expert_load": hm["moe"]["expert_load"],
+            "moe_expert_overflow": hm["moe"]["expert_overflow"],
+            "moe_stall_slot_ticks": hm["moe"]["stall_slot_ticks"],
+            "moe_stall_ticks": hm["moe"]["stall_ticks"],
+            "moe_hbm_bytes_per_decode_step": decode_hbm_bytes_per_step(
+                moe_cfg, moe_params, args.slots),
+            "moe_a2a_bytes_model": round(a2a_bytes, 1),
+            "moe_a2a_recon": {
+                "achieved_gb_s": round(r["achieved_ici_gb_s"], 3),
+                "ici_frac": (round(r["ici_frac"], 6)
+                             if r["ici_frac"] is not None else None),
+                "bound": r["bound"],
+            },
+        }
+
     # ---- the JSON line ---------------------------------------------------
     side = cont_good if args.mode == "continuous" else static_good
     other = static_good if args.mode == "continuous" else cont_good
@@ -1179,6 +1309,7 @@ def main() -> None:
     extras.update(prefix_extras)
     extras.update(longtail_extras)
     extras.update(fleet_extras)
+    extras.update(moe_extras)
     report("serve_goodput", side[top], "tokens/sec",
            baseline=other[top] if other[top] > 0 else None,
            **extras)

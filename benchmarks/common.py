@@ -467,17 +467,20 @@ def outer_sync_terms(float_state_bytes: float, n_slices: int,
 
 def moe_all_to_all_bytes(dispatch_buffer_bytes: float,
                          expert_world: int,
-                         n_layers: int = 1) -> float:
+                         n_layers: int = 1,
+                         passes: int = 4) -> float:
     """Expert-parallel routing traffic per device per step: each MoE layer
-    crosses the expert axis four times — dispatch + return in the forward,
-    the same pair again for the gradients in the backward — each an
-    all_to_all keeping the local 1/e share, so 4·L·B·(e−1)/e where B is
-    the per-device dispatch buffer (e_global · capacity · d_model ·
+    crosses the expert axis ``passes`` times — the training default is 4
+    (dispatch + return in the forward, the same pair again for the
+    gradients in the backward); forward-only serving (decode, prefill)
+    pays only the forward pair, ``passes=2``.  Each crossing is an
+    all_to_all keeping the local 1/e share, so passes·L·B·(e−1)/e where B
+    is the per-device dispatch buffer (e_global · capacity · d_model ·
     itemsize; ``parallel/expert.py`` sizes capacity as
     ceil(top_k · t_local · capacity_factor / e_global))."""
     if expert_world <= 1:
         return 0.0
-    return (4.0 * n_layers * dispatch_buffer_bytes
+    return (float(passes) * n_layers * dispatch_buffer_bytes
             * (expert_world - 1) / expert_world)
 
 

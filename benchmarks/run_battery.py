@@ -275,6 +275,22 @@ BATTERY: list[tuple[str, list[str], int]] = [
       "--decode-impl", "dense", "--weight-dtype", "model",
       "--host-blocks", "0", "--fleet", "2",
       "--fleet-prefix"], 1800),
+    # MoE serving (PR 19): one knob each — serve_continuity + the MoE
+    # A/B phase (expert-parallel decode vs dense at matched active
+    # params), then + int8 expert banks (the wq8 diet applied to the
+    # routed FFN)
+    ("serve_moe",
+     ["benchmarks/bench_serving.py", "--mode", "static",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0", "--fleet", "0",
+      "--moe", "4"], 1800),
+    ("serve_moe_wq8",
+     ["benchmarks/bench_serving.py", "--mode", "static",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--weight-dtype", "int8",
+      "--host-blocks", "0", "--fleet", "0",
+      "--moe", "4"], 1800),
     ("ring_attention_1024",
      ["benchmarks/bench_ring_attention.py", "--seq-len", "1024"], 1500),
     ("ring_attention_2048",
@@ -294,6 +310,10 @@ BATTERY: list[tuple[str, list[str], int]] = [
     # continuity pin, same rule as the gpt2_pp rows: SwitchLM's
     # fused_ce="auto" would otherwise flip this row's loss path on TPU
     ("moe_lm", ["benchmarks/bench_moe_lm.py", "--fused-ce", "off"], 1800),
+    # dropless router A/B (PR 19): argv-identical to moe_lm except the
+    # one knob — capacity-factor-free dispatch, zero dropped tokens
+    ("moe_dropless", ["benchmarks/bench_moe_lm.py", "--fused-ce", "off",
+                      "--dropless"], 1800),
     # resilience A/B (round 10): argv-identical except the one knob — the
     # headline side of the sync/async save pair (both sides are measured in
     # each row; the knob only selects which one is `value`). Platform-
@@ -371,6 +391,9 @@ ROW_PROGRAMS: dict[str, str] = {
     "serve_fleet": "serve_decode_step",
     "serve_disagg": "serve_kv_block_transfer_dcn",
     "serve_fleet_prefix": "serve_decode_step",
+    "moe_dropless": "moe_dropless_train_step",
+    "serve_moe": "serve_decode_step_moe",
+    "serve_moe_wq8": "serve_decode_step_moe_wq8",
 }
 
 

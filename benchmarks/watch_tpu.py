@@ -65,6 +65,9 @@ FIRST_WINDOW = [
     "serve_fleet",             # scale-out fleet A/B (PR 18),
     "serve_disagg",            # + disaggregated prefill/decode roles,
     "serve_fleet_prefix",      # + fleet-level prefix routing
+    "serve_moe",               # expert-parallel MoE decode A/B (PR 19),
+    "serve_moe_wq8",           # + int8 expert banks
+    "moe_dropless",            # dropless router A/B vs moe_lm (PR 19)
     "gpt2_pp_fused_ce",
     "gpt2_pp_gpipe",
     "gpt2_flash_seq1024",

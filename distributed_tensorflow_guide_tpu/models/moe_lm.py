@@ -88,7 +88,8 @@ class SwitchLM:
 
     def __init__(self, mesh: Mesh, cfg: TransformerConfig,
                  num_experts: int, *, top_k: int = 1,
-                 capacity_factor: float = 2.0, aux_weight: float = 1e-2,
+                 capacity_factor: float = 2.0, router: str = "switch",
+                 aux_weight: float = 1e-2,
                  fused_ce="auto", ce_chunk: int | None = None,
                  precision=None):
         if precision is not None:
@@ -110,7 +111,7 @@ class SwitchLM:
         self.aux_weight = aux_weight
         self.moe_cfg = MoEConfig(
             d_model=cfg.d_model, d_ff=cfg.d_ff, num_experts=num_experts,
-            top_k=top_k, capacity_factor=capacity_factor,
+            top_k=top_k, capacity_factor=capacity_factor, router=router,
             dtype=cfg.dtype,
         )
         self.embedder = _Embedder(cfg)
@@ -315,53 +316,68 @@ def lint_contracts():
     # (e_local=1, E*C=16, d=16) is the same 1024 B by construction)
     n_expert, n_layers, top_k, cap_factor = 4, 2, 1, 2.0
 
-    def _build():
-        import jax
-        import optax
+    def _make_build(router):
+        def _build():
+            import jax
+            import optax
 
-        from distributed_tensorflow_guide_tpu.analysis.fixtures import (
-            tiny_lm_cfg,
-        )
-        from distributed_tensorflow_guide_tpu.core.mesh import (
-            MeshSpec,
-            build_mesh,
-        )
+            from distributed_tensorflow_guide_tpu.analysis.fixtures import (
+                tiny_lm_cfg,
+            )
+            from distributed_tensorflow_guide_tpu.core.mesh import (
+                MeshSpec,
+                build_mesh,
+            )
 
-        cfg = tiny_lm_cfg()
-        mesh = build_mesh(MeshSpec(data=2, expert=n_expert))
-        lm = SwitchLM(mesh, cfg, num_experts=n_expert, top_k=top_k,
-                      capacity_factor=cap_factor, fused_ce=False)
-        params = jax.eval_shape(lm.init_params, jax.random.PRNGKey(0))
-        tx = optax.sgd(0.1)
-        opt_state = jax.eval_shape(tx.init, params)
-        step = lm.make_train_step(tx, params, donate=True)
-        tokens = jax.ShapeDtypeStruct((8, 8), "int32")
-        return step, (opt_state, params, tokens)
+            cfg = tiny_lm_cfg()
+            mesh = build_mesh(MeshSpec(data=2, expert=n_expert))
+            lm = SwitchLM(mesh, cfg, num_experts=n_expert, top_k=top_k,
+                          capacity_factor=cap_factor, router=router,
+                          fused_ce=False)
+            params = jax.eval_shape(lm.init_params, jax.random.PRNGKey(0))
+            tx = optax.sgd(0.1)
+            opt_state = jax.eval_shape(tx.init, params)
+            step = lm.make_train_step(tx, params, donate=True)
+            tokens = jax.ShapeDtypeStruct((8, 8), "int32")
+            return step, (opt_state, params, tokens)
 
-    def _a2a_expect():
+        return _build
+
+    _build = _make_build("switch")
+
+    def _a2a_expect(router="switch"):
         t_local, d_model = 8, 16
-        capacity = max(1, -(-top_k * t_local * int(cap_factor) // n_expert))
+        if router == "dropless":
+            capacity = t_local
+        else:
+            capacity = max(1,
+                           -(-top_k * t_local * int(cap_factor) // n_expert))
         dispatch_bytes = n_expert * capacity * d_model * 4
         return closed_forms().moe_all_to_all_bytes(
             dispatch_bytes, n_expert, n_layers=n_layers)
+
+    # Same census as the switch row (dropless changes the CAPACITY, not the
+    # collective structure), but the byte pin doubles: C = t_local = 8 vs
+    # the fixed-capacity 4 — the price of zero drops, stated exactly.
+    _moe_census = {
+        # dispatch + return per scan body, forward and backward
+        "all_to_all[expert]": 4,
+        # replicated-leaf grad psums (embed/attn/ln2/router/head
+        # trees) + the loss/aux metric pmeans over both token axes
+        "psum[data,expert]": 13,
+        # the two expert-sharded stacks (w_in, w_out) reduce over
+        # data ONLY — their expert contributions arrived through
+        # the backward all_to_all; a psum[data,expert] here would
+        # double-count across experts
+        "psum[data]": 2,
+    }
 
     return [
         ProgramContract(
             name="moe_train_step",
             build=_build,
             policy="f32",
-            collectives={
-                # dispatch + return per scan body, forward and backward
-                "all_to_all[expert]": 4,
-                # replicated-leaf grad psums (embed/attn/ln2/router/head
-                # trees) + the loss/aux metric pmeans over both token axes
-                "psum[data,expert]": 13,
-                # the two expert-sharded stacks (w_in, w_out) reduce over
-                # data ONLY — their expert contributions arrived through
-                # the backward all_to_all; a psum[data,expert] here would
-                # double-count across experts
-                "psum[data]": 2,
-            },
+            collectives=dict(_moe_census),
             donation=DonationSpec(argnums=(0, 1)),
             sources=(
                 "distributed_tensorflow_guide_tpu.models.moe_lm",
@@ -377,4 +393,25 @@ def lint_contracts():
                 ),
                 max_peak_live_bytes=262144),
             notes="Switch-MoE step: tokens travel, expert params stay"),
+        ProgramContract(
+            name="moe_dropless_train_step",
+            build=_make_build("dropless"),
+            policy="f32",
+            collectives=dict(_moe_census),
+            donation=DonationSpec(argnums=(0, 1)),
+            sources=(
+                "distributed_tensorflow_guide_tpu.models.moe_lm",
+                "distributed_tensorflow_guide_tpu.parallel.expert",
+                "distributed_tensorflow_guide_tpu.collectives.collectives",
+            ),
+            cost=CostSpec(
+                pins=(
+                    CostPin("collective_bytes[all_to_all[expert]]",
+                            lambda: _a2a_expect("dropless"),
+                            note="same 4-crossing census, C widened to "
+                                 "t_local — the exact byte price of "
+                                 "dropless routing"),
+                ),
+                max_peak_live_bytes=262144),
+            notes="dropless Switch step: capacity = t_local, zero drops"),
     ]

@@ -156,6 +156,23 @@ class TransformerConfig:
     # transfer. Gate with core.precision.require_fp8(): pre-fp8 TPU
     # generations emulate e4m3 at a net loss.
     fp8_matmuls: bool = False
+    # Routed MoE FFN (Switch-style top-1) for the flat Transformer — the
+    # serve-side sibling of models/moe_lm.py's SwitchLM. ``moe_experts``
+    # set → every Block's FFN becomes MoEMLP: a per-token f32 router picks
+    # one expert from a bank of (E, d, ff)/(E, ff, d) kernels and the
+    # token travels through a fixed-capacity dispatch buffer (static
+    # shapes, one-hot algebra — the parallel/expert.py discipline on a
+    # single device). ``moe_capacity`` bounds the per-expert buffer for
+    # SINGLE-TOKEN (decode) calls: a token past capacity is NOT dropped —
+    # its FFN output is zeroed and its per-token overflow flag is sown
+    # into the "moe_stats" collection so the serve engine can stall the
+    # slot and retry (degrade-to-overflow semantics; serve/engine.py).
+    # Multi-token calls (prefill chunks, one-shot, training) widen the
+    # buffer to the token count, which provably admits every token.
+    # ``moe_capacity=None`` is the always-dropless oracle. None/None
+    # (default) keeps every historical trace byte-identical.
+    moe_experts: int | None = None
+    moe_capacity: int | None = None
 
     def __post_init__(self):
         if self.attn_impl not in ("auto", "dense", "flash"):
@@ -228,6 +245,27 @@ class TransformerConfig:
                     "(the quantized projections have no f32 kernel for "
                     "the deltas to ride on)"
                 )
+        if self.moe_capacity is not None and self.moe_experts is None:
+            raise ValueError("moe_capacity requires moe_experts")
+        if self.moe_experts is not None:
+            if self.moe_experts < 2:
+                raise ValueError(
+                    f"moe_experts must be >= 2, got {self.moe_experts}")
+            if self.moe_capacity is not None and self.moe_capacity < 1:
+                raise ValueError(
+                    f"moe_capacity must be >= 1, got {self.moe_capacity}")
+            if self.lora_rank is not None:
+                raise ValueError(
+                    "moe_experts and lora_rank are mutually exclusive "
+                    "(no delta bank wiring on the routed FFN)")
+            if self.quantized_matmuls or self.fp8_matmuls:
+                raise ValueError(
+                    "moe_experts and the training quant levers are "
+                    "mutually exclusive (SwitchLM owns MoE training)")
+            if self.tp_axis:
+                raise ValueError(
+                    "moe_experts and tp_axis are mutually exclusive "
+                    "(expert parallelism is the MoE sharding story)")
         if self.quantized_matmuls or self.fp8_matmuls:
             lever = ("quantized_matmuls" if self.quantized_matmuls
                      else "fp8_matmuls")
@@ -249,6 +287,10 @@ class TransformerConfig:
     @property
     def paged(self) -> bool:
         return self.paged_num_blocks is not None
+
+    @property
+    def moe(self) -> bool:
+        return self.moe_experts is not None
 
     @property
     def lora(self) -> bool:
@@ -915,6 +957,159 @@ class MLP(nn.Module):
         return y
 
 
+class _ExpertBank(nn.Module):
+    """The f32 per-expert kernel stack of one MoE projection: a single
+    ``kernel`` param of shape (E, d_in, d_out) under this module's name —
+    the exact ``{name: {kernel}}`` layout ``ops.quant.quantize_params``
+    rewrites per expert (``WQ_BANKS``)."""
+
+    shape: tuple
+    names: tuple
+
+    @nn.compact
+    def __call__(self):
+        return self.param("kernel", _dense_init(*self.names), self.shape,
+                          jnp.float32)
+
+
+class _WeightQuantBank(nn.Module):
+    """Weight-only quantized sibling of :class:`_ExpertBank`
+    (``cfg.weight_dtype`` on the expert banks): declares ``qkernel``
+    (E, d_in[, /2], d_out) at the storage dtype plus per-expert
+    per-output-column ``scale`` (E, d_out) f32 — exactly what
+    ``quantize_params`` produces from the f32 bank under the SAME module
+    name. The dequant is fused after the expert gather
+    (``ops.quant.wq_bank_matmul``); init values are placeholders."""
+
+    shape: tuple  # logical (E, d_in, d_out)
+    bits: Any = 8
+
+    @nn.compact
+    def __call__(self):
+        e, d_in, d_out = self.shape
+        if self.bits == 4:
+            if d_in % 2:
+                raise ValueError(
+                    f"int4 packing needs an even fan-in, got {d_in}")
+            rows, store = d_in // 2, jnp.uint8
+        elif self.bits == "fp8":
+            rows, store = d_in, jnp.float8_e4m3fn
+        else:
+            rows, store = d_in, jnp.int8
+        qkernel = self.param("qkernel", nn.initializers.zeros_init(),
+                             (e, rows, d_out), store)
+        scale = self.param("scale", nn.initializers.ones_init(),
+                           (e, d_out), jnp.float32)
+        return qkernel, scale
+
+
+class MoEMLP(nn.Module):
+    """Routed top-1 MoE FFN (``cfg.moe_experts``) — the MoE sibling of
+    :class:`MLP`, single-device (the serve engine's view; EP sharding is
+    models/moe_lm.py's story).
+
+    The parallel/expert.py dispatch discipline without the mesh: a f32
+    router picks one expert per token, tokens are copied into a
+    fixed-capacity (E, C, d) buffer by one-hot einsum (static shapes,
+    MXU-friendly batched expert contraction), and the combine gathers the
+    gated outputs back. ``C = cfg.moe_capacity`` for single-token
+    (decode) calls; multi-token calls (prefill chunks, one-shot oracle,
+    ``moe_capacity=None``) widen ``C`` to the token count, which provably
+    admits every token (top-1: an expert can receive at most T rows).
+
+    A token past capacity is never dropped silently OR routed elsewhere:
+    its dispatch row is zero (the FFN contributes nothing) and its
+    overflow flag is sown into the ``moe_stats`` collection —
+    ``serve/engine.py`` discards the slot's sampled token and retries the
+    SAME token next tick, so every emitted token was computed by its true
+    expert (degrade-to-overflow semantics). Dispatch fills in token order
+    (cumsum), so the lowest-indexed contending slot always wins a
+    capacity seat and at least one slot advances every tick.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, moe_mask=None) -> jax.Array:
+        cfg = self.cfg
+        e = cfg.moe_experts
+        b, s, d = x.shape
+        t = b * s
+        xt = x.reshape(t, d)
+        if cfg.moe_capacity is None or s > 1:
+            capacity = t
+        else:
+            capacity = cfg.moe_capacity
+
+        # router always in f32: routing decisions are precision-sensitive
+        # (the parallel/expert.py rule); name "router" is NOT in
+        # WQ_PROJECTIONS, so quantize_params leaves it full precision
+        logits = nn.Dense(
+            e, dtype=jnp.float32,
+            kernel_init=_dense_init("embed", "expert"),
+            use_bias=False, name="router",
+        )(xt.astype(jnp.float32))
+        gates = jax.nn.softmax(logits, axis=-1)
+
+        # top-1 fixed-capacity dispatch, entirely one-hot algebra: exact
+        # row copies in, exact gated gathers out — zeros added everywhere
+        # else, so the per-token value is independent of C (the basis of
+        # the engine-vs-oracle bitwise pin)
+        idx = jnp.argmax(gates, axis=1)                       # (T,)
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        if moe_mask is not None:
+            # serve-engine padding mask: idle decode slots / prefill pad
+            # rows route NOWHERE — they consume no capacity (an idle slot
+            # must never starve a live one) and contribute nothing to the
+            # load/overflow census. Masking cannot change a live token's
+            # value: it only ever frees capacity seats, and a row's dot
+            # is independent of its buffer position.
+            onehot = onehot * moe_mask.reshape(t).astype(
+                jnp.float32)[:, None]
+        pos = jnp.cumsum(onehot, axis=0) - onehot             # (T, E)
+        pos_i = pos.astype(jnp.int32)
+        keep = onehot * (pos_i < capacity)
+        dispatch = keep[:, :, None] * jax.nn.one_hot(
+            pos_i, capacity, dtype=jnp.float32)               # (T, E, C)
+        gate_val = jnp.sum(gates * onehot, axis=1)            # (T,)
+        combine = dispatch * gate_val[:, None, None]
+
+        # per-expert load / overflow census for the obs plane; sow is a
+        # no-op unless the caller passes mutable=["moe_stats"] (the serve
+        # step fns do; training and the one-shot oracle don't)
+        dropped = onehot - keep
+        self.sow("moe_stats", "load", jnp.sum(keep, axis=0))
+        self.sow("moe_stats", "overflow", jnp.sum(dropped, axis=0))
+        self.sow("moe_stats", "overflow_tok", jnp.sum(dropped, axis=1))
+
+        xb = jnp.einsum("tec,td->ecd", dispatch.astype(cfg.dtype),
+                        xt.astype(cfg.dtype))
+        shape_in = (e, cfg.d_model, cfg.d_ff)
+        shape_out = (e, cfg.d_ff, cfg.d_model)
+        from distributed_tensorflow_guide_tpu.ops import quant
+
+        if cfg.weight_dtype:
+            bits = _WQ_BITS[cfg.weight_dtype]
+            q_in, s_in = _WeightQuantBank(shape_in, bits=bits,
+                                          name="w_in")()
+            q_out, s_out = _WeightQuantBank(shape_out, bits=bits,
+                                            name="w_out")()
+            h = nn.gelu(quant.wq_bank_matmul(xb, q_in, s_in, bits=bits,
+                                             dtype=cfg.dtype))
+            out = quant.wq_bank_matmul(h, q_out, s_out, bits=bits,
+                                       dtype=cfg.dtype)
+        else:
+            w_in = _ExpertBank(shape_in, ("expert", "embed", "mlp"),
+                               name="w_in")()
+            w_out = _ExpertBank(shape_out, ("expert", "mlp", "embed"),
+                                name="w_out")()
+            h = nn.gelu(jnp.einsum("ecd,edf->ecf", xb,
+                                   w_in.astype(cfg.dtype)))
+            out = jnp.einsum("ecf,efd->ecd", h, w_out.astype(cfg.dtype))
+        y = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), out)
+        return y.reshape(b, s, d).astype(x.dtype)
+
+
 class Block(nn.Module):
     """Pre-LN transformer block: x + attn(LN(x)); x + mlp(LN(x))."""
 
@@ -922,7 +1117,8 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, index=None, *,
-                 block_tables=None, adapter=None) -> jax.Array:
+                 block_tables=None, adapter=None,
+                 moe_mask=None) -> jax.Array:
         cfg = self.cfg
         # Attention-only selective remat (core/precision.py): checkpoint the
         # attention sub-layer here so EVERY consumer — the flat Transformer,
@@ -943,9 +1139,12 @@ class Block(nn.Module):
         else:
             x = x + attn(h, index, block_tables=block_tables,
                          adapter=adapter)
-        mlp = MLP(cfg, name="mlp")
+        mlp = (MoEMLP(cfg, name="mlp") if cfg.moe
+               else MLP(cfg, name="mlp"))
         h2 = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
-        if adapter is None:  # the historical call, kept verbatim
+        if moe_mask is not None:
+            x = x + mlp(h2, moe_mask=moe_mask)
+        elif adapter is None:  # the historical call, kept verbatim
             x = x + mlp(h2)
         else:
             x = x + mlp(h2, adapter=adapter)
@@ -960,7 +1159,7 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array, index=None, *,
-                 block_tables=None, adapter=None,
+                 block_tables=None, adapter=None, moe_mask=None,
                  return_hidden: bool = False) -> jax.Array:
         # tokens (B, S) int32; ``index`` only in cfg.decode mode: the
         # absolute position of tokens[:, 0] (prefill passes 0, the decode
@@ -1003,7 +1202,11 @@ class Transformer(nn.Module):
         if cfg.resolved_remat_mode == "block":
             block = nn.remat(Block, prevent_cse=False)
         for i in range(cfg.num_layers):
-            if block_tables is None and adapter is None:
+            if moe_mask is not None:
+                x = block(cfg, name=f"block_{i}")(
+                    x, index, block_tables=block_tables,
+                    moe_mask=moe_mask)
+            elif block_tables is None and adapter is None:
                 # the historical call, kept verbatim
                 x = block(cfg, name=f"block_{i}")(x, index)
             elif adapter is None:

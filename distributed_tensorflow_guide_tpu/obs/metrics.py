@@ -204,6 +204,20 @@ def absorb_engine(reg: Registry, health: dict) -> None:
         for k, v in c.items():
             reg.counter(f"dtg_serve_tenant_{k}_total",
                         labels={"tenant": str(tenant)}).set_total(v)
+    # expert-parallel decode (PR 19): per-expert routed/overflowed token
+    # counts as labeled counters, stall ticks as engine-wide counters —
+    # the load/overflow skew is THE capacity-tuning signal
+    moe = health.get("moe")
+    if moe:
+        for e, v in enumerate(moe.get("expert_load", ())):
+            reg.counter("dtg_moe_expert_load_total",
+                        labels={"expert": str(e)}).set_total(v)
+        for e, v in enumerate(moe.get("expert_overflow", ())):
+            reg.counter("dtg_moe_expert_overflow_total",
+                        labels={"expert": str(e)}).set_total(v)
+        for k in ("stall_slot_ticks", "stall_ticks"):
+            if k in moe:
+                reg.counter(f"dtg_moe_{k}_total").set_total(moe[k])
 
 
 def absorb_fleet(reg: Registry, health: dict) -> None:

@@ -59,8 +59,10 @@ __all__ = [
     "pack_int4",
     "unpack_int4",
     "wq_matmul",
+    "wq_bank_matmul",
     "quantize_params",
     "WQ_PROJECTIONS",
+    "WQ_BANKS",
     "int8_ste_dot",
     "fp8_ste_dot",
     "int8_pmean",
@@ -166,10 +168,37 @@ def wq_matmul(x, qkernel, scale, *, bits=8, dtype=jnp.float32):
     return (y.astype(jnp.float32) * scale).astype(dtype)
 
 
+def wq_bank_matmul(x, qbank, scale, *, bits=8, dtype=jnp.float32):
+    """:func:`wq_matmul` over a leading EXPERT axis — the MoE
+    expert-bank contraction. ``x`` is ``(E, ..., d_in)`` (the capacity
+    buffer after the dispatch gather), ``qbank`` the stored
+    ``(E, d_in[, /2], d_out)`` per-expert kernels, ``scale`` the
+    per-expert per-output-column f32 scales ``(E, d_out)``. Identical
+    fused-dequant discipline, applied one expert at a time: a single
+    batched dot would widen the WHOLE bank to the compute dtype in one
+    convert (an E x d_in x d_out transient — E times the dense-kernel
+    copy the f32-intermediate cap budgets for), so each expert's stored
+    kernel rides its own contraction instead and the largest widened
+    transient stays at dense-kernel size no matter how many experts the
+    bank holds. E is static at trace time; the per-row reductions are
+    the same as the batched dot's, so results are bitwise identical."""
+    _check_bits(bits)
+    return jnp.stack([
+        wq_matmul(x[e], qbank[e], scale[e], bits=bits, dtype=dtype)
+        for e in range(qbank.shape[0])])
+
+
 #: Projection submodule names quantize_params rewrites, mapped to how many
 #: LEADING kernel axes are contracted (flax DenseGeneral stores kernels as
 #: (in..., out...)): attention ``proj`` contracts (heads, head_dim).
 WQ_PROJECTIONS = {"qkv": 1, "proj": 2, "up": 1, "down": 1, "lm_head": 1}
+
+#: Per-expert FFN bank names (models/transformer.py MoEMLP): 3-D
+#: ``(E, d_in, d_out)`` kernels whose LEADING axis is the expert bank, not
+#: a contracted axis — quantize_params maps them per expert (vmap of the
+#: 2-D transform) to ``qkernel (E, d_in[, /2], d_out)`` + ``scale
+#: (E, d_out)``, the layout :func:`wq_bank_matmul` consumes.
+WQ_BANKS = ("w_in", "w_out")
 
 
 def _unbox(leaf):
@@ -195,7 +224,17 @@ def quantize_params(params, *, bits=8,
             return node
         out = {}
         for name, child in node.items():
-            if (name in projections and isinstance(child, dict)
+            if (name in WQ_BANKS and isinstance(child, dict)
+                    and "kernel" in child):
+                # per-expert bank: vmap the 2-D channelwise transform over
+                # the leading expert axis — one scale row per expert
+                bank = jnp.asarray(_unbox(child["kernel"]))
+                q, scale = jax.vmap(
+                    lambda k: quantize_channelwise(k, bits=bits))(bank)
+                if bits == 4:
+                    q = jax.vmap(pack_int4)(q)
+                out[name] = {"qkernel": q, "scale": scale}
+            elif (name in projections and isinstance(child, dict)
                     and "kernel" in child):
                 n_in = projections[name]
                 kernel = jnp.asarray(_unbox(child["kernel"]))

@@ -48,12 +48,24 @@ class MoEConfig:
     num_experts: int          # global expert count, divisible by axis size
     top_k: int = 2
     capacity_factor: float = 1.25
+    # "switch": fixed-capacity GShard/Switch dispatch — tokens past
+    # ``capacity_factor`` headroom drop. "dropless": capacity-factor-free —
+    # capacity widens to the per-shard token count, which provably admits
+    # every token (a token picks each expert at most once, so no expert can
+    # receive more than T_local rows), at the price of an E×-larger dispatch
+    # buffer. Same one-hot algebra, same all_to_all census, zero drops.
+    router: str = "switch"
     axis: str = "expert"
     # mesh axes (besides `axis`) that also shard the token dimension; aux
     # statistics are averaged over all of them so every device reports the
     # same global value. None for pure-EP shard_maps with no data axis bound.
     data_axis: str | None = "data"
     dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.router not in ("switch", "dropless"):
+            raise ValueError(
+                f"router must be 'switch' or 'dropless', got {self.router!r}")
 
     @property
     def token_axes(self) -> tuple[str, ...]:
@@ -123,8 +135,15 @@ def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig):
             f"{e_global} experts over {n_dev} devices needs "
             f"{e_global // n_dev} local, got {e_local}")
     t_local = x.shape[0]
-    capacity = max(1, int(np.ceil(
-        cfg.top_k * t_local * cfg.capacity_factor / e_global)))
+    if cfg.router == "dropless":
+        # Each token selects an expert at most once across the top_k rounds
+        # (the chosen column is masked between rounds), so no expert is ever
+        # assigned more than t_local rows: capacity == t_local admits every
+        # token and _topk_dispatch's ``pos < capacity`` guard never fires.
+        capacity = t_local
+    else:
+        capacity = max(1, int(np.ceil(
+            cfg.top_k * t_local * cfg.capacity_factor / e_global)))
 
     # router always in fp32: routing decisions are precision-sensitive
     logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)

@@ -194,6 +194,30 @@ def _pool_scatter(pool, idx, rows):
                   for leaf, r in zip(leaves, rows)])
 
 
+def _moe_fold(stats):
+    """Fold the model's per-layer ``moe_stats`` sow tree into
+    ``(load (E,), overflow (E,), overflow_tok (T,))`` — each summed over
+    layers (sow appends one tuple entry per MoEMLP site). Runs inside the
+    jitted step, so the engine gets three small arrays back instead of a
+    nested per-block tree."""
+    import collections.abc as _abc
+
+    load, overflow, of_tok = [], [], []
+
+    def walk(node):
+        if isinstance(node, _abc.Mapping):
+            if "load" in node and "overflow" in node:
+                load.extend(node["load"])
+                overflow.extend(node["overflow"])
+                of_tok.extend(node["overflow_tok"])
+            else:
+                for k in sorted(node):
+                    walk(node[k])
+
+    walk(stats)
+    return sum(load), sum(overflow), sum(of_tok)
+
+
 _STEP_FNS = {}
 
 
@@ -219,6 +243,7 @@ def build_step_fns(cfg: TransformerConfig, *, slots: int, num_blocks: int,
     model = Transformer(pcfg)
     n_blk = pcfg.max_len // block_size
     lora = pcfg.lora_rank is not None
+    moe = pcfg.moe
 
     if lora:
         # still exactly two jitted programs: the LoRA engine's pair takes
@@ -247,6 +272,41 @@ def build_step_fns(cfg: TransformerConfig, *, slots: int, num_blocks: int,
                           jax.random.fold_in(key, start[0] + valid),
                           temperature, top_k)[0]
             return tok, mut["cache"]
+    elif moe:
+        # still exactly two jitted programs: the MoE pair runs the router
+        # dispatch INSIDE the step (mutable=["moe_stats"] so the sown
+        # census comes back) and returns the per-slot overflow flags the
+        # engine's stall-and-retry loop consumes. Idle slots are masked
+        # out of routing (written == 0), so a garbage slot can never
+        # consume a capacity seat a live slot needs.
+        def decode_step(params, pool, tables, written, last_tok, keys):
+            logits, mut = model.apply(
+                {"params": params, "cache": pool},
+                last_tok[:, None], written, block_tables=tables,
+                moe_mask=written > 0, mutable=["cache", "moe_stats"])
+            load, overflow, of_tok = _moe_fold(mut["moe_stats"])
+            pos_keys = jax.vmap(jax.random.fold_in)(keys, written + 1)
+            nxt = sample_rows(logits[:, -1], pos_keys, temperature, top_k)
+            return nxt, mut["cache"], of_tok > 0, load, overflow
+
+        def prefill_chunk_step(params, pool, tables, start, chunk, valid,
+                               key):
+            # the dispatch buffer widens to the chunk length (MoEMLP:
+            # multi-token calls are dropless by construction), so a
+            # prefill chunk can never overflow — only pad rows past
+            # ``valid`` are masked out of the census
+            mask = (jnp.arange(chunk.shape[1]) < valid)[None, :]
+            logits, mut = model.apply(
+                {"params": params, "cache": pool},
+                chunk, start, block_tables=tables,
+                moe_mask=mask, mutable=["cache", "moe_stats"])
+            load, overflow, _ = _moe_fold(mut["moe_stats"])
+            last = lax.dynamic_index_in_dim(logits[0], valid - 1, axis=0,
+                                            keepdims=False)
+            tok = _sample(last[None],
+                          jax.random.fold_in(key, start[0] + valid),
+                          temperature, top_k)[0]
+            return tok, mut["cache"], load, overflow
     else:
         def decode_step(params, pool, tables, written, last_tok, keys):
             """(S,) tokens in, (S,) tokens out; pool threaded
@@ -289,7 +349,7 @@ def build_step_fns(cfg: TransformerConfig, *, slots: int, num_blocks: int,
     fns = SimpleNamespace(
         decode=decode_jit, prefill=prefill_jit, model=model, cfg=pcfg,
         n_blk=n_blk, declared_donate_argnums=(1,), donates_pool=donate,
-        temperature=temperature, top_k=top_k, lora=lora)
+        temperature=temperature, top_k=top_k, lora=lora, moe=moe)
     _STEP_FNS[memo_key] = fns
     return fns
 
@@ -408,6 +468,16 @@ class ServeEngine:
             trash = self.sched.pool.trash_block
             self._cache_h2d(trash, self._cache_d2h(trash))
         self.steps = {"decode": 0, "prefill": 0, "idle": 0}
+        # MoE serving census (observe-only, absorbed by obs/metrics):
+        # per-expert token load / overflow counts summed over launches
+        # and layers, plus the stall tally of the degrade-to-overflow
+        # retry loop (a stalled slot-tick is one discarded sample)
+        if self.fns.moe:
+            n_e = self.fns.cfg.moe_experts
+            self._moe_load = np.zeros((n_e,), np.int64)
+            self._moe_overflow = np.zeros((n_e,), np.int64)
+            self._moe_stall_slot_ticks = 0
+            self._moe_stall_ticks = 0
         # failure hardening (PR 11)
         self.chaos = chaos  # a testing.chaos.FaultSchedule (or None)
         self.burst_factory = burst_factory  # (n, now) -> [Request]
@@ -709,9 +779,16 @@ class ServeEngine:
         if self.fns.lora:
             args += (self.adapters,
                      jnp.full((1,), s.adapter, jnp.int32))
-        tok, self.pool = self._launch(
-            lambda: self.fns.prefill(*args),
-            tag="serve_prefill_chunk_step")
+        if self.fns.moe:
+            tok, self.pool, load, overflow = self._launch(
+                lambda: self.fns.prefill(*args),
+                tag="serve_prefill_chunk_step")
+            self._moe_load += np.asarray(load).astype(np.int64)
+            self._moe_overflow += np.asarray(overflow).astype(np.int64)
+        else:
+            tok, self.pool = self._launch(
+                lambda: self.fns.prefill(*args),
+                tag="serve_prefill_chunk_step")
         return [Event(now, *ev) for ev in
                 self.sched.apply_prefill(i, int(tok))]
 
@@ -735,6 +812,34 @@ class ServeEngine:
                 jnp.asarray(keys))
         if self.fns.lora:
             args += (self.adapters, jnp.asarray(adapter_ids))
+        if self.fns.moe:
+            nxt, self.pool, of_tok, load, overflow = self._launch(
+                lambda: self.fns.decode(*args),
+                tag="serve_decode_step")
+            self._moe_load += np.asarray(load).astype(np.int64)
+            self._moe_overflow += np.asarray(overflow).astype(np.int64)
+            of = np.asarray(of_tok)
+            nxt = np.asarray(nxt)
+            events = []
+            stalled = 0
+            for i in ready:
+                if of[i]:
+                    # degrade-to-overflow: the slot's sampled token came
+                    # from a forward that skipped its expert at some
+                    # layer — discard it and leave pending/written
+                    # untouched, so the SAME token retries next tick
+                    # (cache rewrites are idempotent; dispatch fills in
+                    # slot order, so the lowest contending slot always
+                    # advances). A hot expert costs goodput, never a
+                    # dropped or corrupted token.
+                    stalled += 1
+                    continue
+                events.extend(Event(now, *ev) for ev in
+                              self.sched.apply_decode(i, int(nxt[i])))
+            if stalled:
+                self._moe_stall_slot_ticks += stalled
+                self._moe_stall_ticks += 1
+            return events
         nxt, self.pool = self._launch(
             lambda: self.fns.decode(*args),
             tag="serve_decode_step")
@@ -886,6 +991,12 @@ class ServeEngine:
             "tenants": {t: dict(c) for t, c in sorted(sd.tenants.items())},
             "last_tick_s": self.last_tick_s,
             "ticks": self._tick,
+            **({"moe": {
+                "expert_load": [int(x) for x in self._moe_load],
+                "expert_overflow": [int(x) for x in self._moe_overflow],
+                "stall_slot_ticks": int(self._moe_stall_slot_ticks),
+                "stall_ticks": int(self._moe_stall_ticks),
+            }} if self.fns.moe else {}),
         }
 
     # ---- snapshot / restore ----------------------------------------------
@@ -1128,7 +1239,10 @@ def lint_contracts():
                 tiny_lm_cfg(vocab_size=32, max_len=MAXLEN),
                 decode_impl="pallas",
                 **({"lora_rank": 2, "lora_adapters": 2} if lora else {}),
-                **({"weight_dtype": "int8"} if kind == "decode_wq8"
+                **({"moe_experts": 4, "moe_capacity": 2}
+                   if "moe" in kind else {}),
+                **({"weight_dtype": "int8"}
+                   if kind in ("decode_wq8", "decode_moe_wq8")
                    else {"weight_dtype": "fp8"} if kind == "decode_wqfp8"
                    else {}))
             fns = build_step_fns(cfg, slots=S, num_blocks=NB,
@@ -1204,6 +1318,49 @@ def lint_contracts():
         f32_vec = cost_mod.program_cost(traced, sibling)
         return f32_vec.hbm_bytes_read - WQ8_SAVED_BYTES
 
+    # the MoE fixture's quantized kernel elems: per layer qkv 768 +
+    # proj 256 + expert banks w_in 4*16*32 = 2048 + w_out 4*32*16 = 2048
+    # (the routed FFN replaces MLP up/down; the f32 router is exempt),
+    # x 2 layers, plus lm_head 512 -> 10752; int8 storage saves 3 bytes
+    # per elem on the decode read — the ~4x cold-bank diet, byte-exact
+    MOE_WQ8_SAVED_BYTES = 3 * 10752
+
+    def _moe_wq8_hbm_read_expect():
+        """The f32 MoE sibling's derived read bytes minus the weight-only
+        savings — the serve_decode_step_wq8 trace-and-subtract discipline
+        applied to the expert banks, so the pin only passes if
+        quantization removed exactly the kernel+bank bytes and changed
+        nothing else about the MoE program's traffic."""
+        import jax.numpy as _jnp
+
+        from distributed_tensorflow_guide_tpu.analysis import (
+            cost as cost_mod,
+            rules as rules_mod,
+        )
+
+        fn, args = _build("decode_moe")()
+        jaxpr = jax.make_jaxpr(fn)(*args)
+        traced = rules_mod.TracedProgram(
+            name="serve_decode_step_moe", jaxpr=jaxpr,
+            arg_leaf_avals=[
+                [jax.ShapeDtypeStruct(_jnp.shape(x), _jnp.result_type(x))
+                 for x in jax.tree.leaves(a)] for a in args])
+        f32_vec = cost_mod.program_cost(traced, moe_sibling)
+        return f32_vec.hbm_bytes_read - MOE_WQ8_SAVED_BYTES
+
+    moe_sibling = ProgramContract(
+        name="serve_decode_step_moe",
+        build=_build("decode_moe"),
+        # the MoE pair carries the expert banks (2 layers x 4 experts x
+        # (16*32 + 32*16) f32 = 16 KiB of extra resident params) on top
+        # of the shared pool band — its own ceiling, same discipline
+        cost=CostSpec(max_peak_live_bytes=131072),
+        notes="expert-parallel decode: router dispatch + fixed-capacity "
+              "expert contraction INSIDE the step; per-slot overflow "
+              "flags drive the engine's stall-and-retry (degrade, never "
+              "drop); idle slots masked out of capacity",
+        **common)
+
     sibling = ProgramContract(
         name="serve_decode_step",
         build=_build("decode"),
@@ -1263,5 +1420,32 @@ def lint_contracts():
             cost=CostSpec(max_peak_live_bytes=98304),
             notes="multi-adapter decode: gathered low-rank deltas stay "
                   "collective-free and under the f32 intermediate cap",
+            **common),
+        moe_sibling,
+        ProgramContract(
+            name="serve_decode_step_moe_wq8",
+            build=_build("decode_moe_wq8"),
+            quantized_matmuls=True,
+            cost=CostSpec(
+                pins=(CostPin(
+                    "hbm_bytes_read", _moe_wq8_hbm_read_expect,
+                    note="f32 MoE decode read bytes minus 3 B x 10752 "
+                         "quantized kernel+bank elems — the cold expert "
+                         "bank pays the same fused-dequant diet as the "
+                         "dense projections"),),
+                max_peak_live_bytes=131072),
+            notes="weight-only int8 MoE decode: per-expert qkernel+scale "
+                  "banks, dequant fused AFTER the expert gather "
+                  "(wq_bank_matmul); same program shape as "
+                  "serve_decode_step_moe",
+            **common),
+        ProgramContract(
+            name="serve_prefill_chunk_step_moe",
+            build=_build("prefill_moe"),
+            cost=CostSpec(max_peak_live_bytes=131072),
+            notes="B=1 MoE chunked prefill: the dispatch buffer widens "
+                  "to the chunk length (dropless by construction — a "
+                  "prefill token can never overflow), pad rows masked "
+                  "out of the census",
             **common),
     ]

@@ -195,6 +195,10 @@ class FleetScheduler:
         self.replicas_regrown = 0
         self.timeline: list[dict] = []
         self._fleet_tenants: dict[int, dict[str, int]] = {}
+        # autoscale_policy hysteresis state: the direction the signal
+        # has been leaning and for how many consecutive evaluations
+        self._scale_direction = 0
+        self._scale_streak = 0
 
     # ---- intake ----------------------------------------------------------
 
@@ -551,6 +555,60 @@ class FleetScheduler:
                 pressure > 1.0 or len(live) < len(self.engines)),
         }
 
+    def autoscale_policy(self, *, min_replicas: int = 1,
+                         max_replicas: int | None = None,
+                         up_pressure: float = 1.0,
+                         down_pressure: float = 0.25,
+                         hysteresis: int = 3) -> dict:
+        """:meth:`autoscale_signal` -> a target-replica-count
+        RECOMMENDATION.  Advisory only: the supervisor never acts on it
+        (shed/regrow stay world-chaos-driven); an external operator is
+        the intended consumer.
+
+        Hysteresis: the signal must lean the same direction for
+        ``hysteresis`` consecutive evaluations before the target moves
+        off the current live count, and then it moves by ONE replica —
+        a flapping queue cannot saw the fleet.  Scale-down additionally
+        requires an empty queue (draining capacity under backlog is
+        never recommended).  The target is clamped to
+        ``[min_replicas, max_replicas]`` (default max: the fleet's
+        provisioned width)."""
+        if min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {min_replicas}")
+        cap = (len(self.engines) if max_replicas is None
+               else int(max_replicas))
+        if cap < min_replicas:
+            raise ValueError(
+                f"max_replicas {cap} < min_replicas {min_replicas}")
+        sig = self.autoscale_signal()
+        live = sig["live_replicas"]
+        if sig["pressure"] > up_pressure:
+            direction = 1
+        elif sig["pressure"] < down_pressure and sig["queued"] == 0:
+            direction = -1
+        else:
+            direction = 0
+        if direction != 0 and direction == self._scale_direction:
+            self._scale_streak += 1
+        else:
+            self._scale_direction = direction
+            self._scale_streak = 1 if direction else 0
+        target = live
+        if direction and self._scale_streak >= hysteresis:
+            target = live + direction
+        target = max(min_replicas, min(cap, target))
+        return {
+            "target_replicas": target,
+            "live_replicas": live,
+            "direction": direction,
+            "streak": self._scale_streak,
+            "hysteresis": hysteresis,
+            "min_replicas": min_replicas,
+            "max_replicas": cap,
+            "signal": sig,
+        }
+
     # ---- the fleet tick --------------------------------------------------
 
     def step(self, now: float = 0.0) -> tuple[list[Event], str]:
@@ -675,6 +733,7 @@ class FleetScheduler:
             "prefix_route_hits": self.prefix_route_hits,
             "prefix_route_hit_tokens": self.prefix_route_hit_tokens,
             "completed": sum(h["completed"] for h in replicas),
+            "autoscale": self.autoscale_policy(),
         }
 
     def check_leaks(self) -> None:

@@ -351,3 +351,57 @@ def test_fleet_config_validation(params):
         fl.submit(Request(rid=0, prompt=PROMPTS[2], max_new_tokens=63,
                           rng=jax.random.PRNGKey(0)))
     fl.close()
+
+
+# ---- autoscale policy (PR 19): advisory target-replica recommendation ------
+
+
+def test_autoscale_policy_hysteresis_bounds_and_health(params):
+    """autoscale_signal -> target-replica recommendation: the signal
+    must lean the same way for ``hysteresis`` consecutive evaluations
+    before the target moves (by one), the target clamps to
+    [min_replicas, max_replicas], and the whole thing is ADVISORY —
+    the fleet's live set never changes. Surfaced in health()."""
+    fl = _fleet(params)
+
+    # idle fleet, empty queue: pressure 0 leans scale-down, but the
+    # target holds at live until the streak reaches the hysteresis
+    p = fl.autoscale_policy()
+    assert p["direction"] == -1 and p["streak"] == 1
+    assert p["target_replicas"] == 2  # no move yet
+    assert fl.autoscale_policy()["target_replicas"] == 2
+    p = fl.autoscale_policy()
+    assert p["streak"] == 3 and p["target_replicas"] == 1
+    # the min bound overrides a mature scale-down streak
+    assert fl.autoscale_policy(min_replicas=2)["target_replicas"] == 2
+
+    # queue pressure: 9 queued over 2x2 capacity leans scale-up; the
+    # direction flip resets the streak, so again no move until 3 in a
+    # row, and the default max bound is the PROVISIONED width (2)
+    for i in range(9):
+        fl.submit(Request(rid=100 + i, prompt=PROMPTS[0],
+                          max_new_tokens=4,
+                          rng=jax.random.PRNGKey(i)))
+    p = fl.autoscale_policy()
+    assert p["direction"] == 1 and p["streak"] == 1
+    assert p["target_replicas"] == 2
+    fl.autoscale_policy()
+    assert fl.autoscale_policy()["target_replicas"] == 2  # clamped
+    # with headroom granted, the mature streak recommends ONE more
+    p = fl.autoscale_policy(max_replicas=4)
+    assert p["target_replicas"] == 3
+    assert p["signal"]["pressure"] > 1.0
+
+    # advisory only: nothing above touched the live set
+    assert len(fl._live) == 2
+    h = fl.health()
+    assert h["autoscale"]["target_replicas"] >= 2
+    assert h["autoscale"]["signal"]["queued"] == 9
+
+    with pytest.raises(ValueError, match="min_replicas"):
+        fl.autoscale_policy(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        fl.autoscale_policy(min_replicas=2, max_replicas=1)
+    fl.run()
+    fl.check_leaks()
+    fl.close()

@@ -116,3 +116,39 @@ def test_opt_state_moments_inherit_expert_sharding():
     # replicated groups stay replicated
     emb_leaf = jax.tree.leaves(mu["embed"])[0]
     assert "expert" not in tuple(s for s in emb_leaf.sharding.spec if s)
+
+
+def _loss_once(router, capacity_factor, *, num_experts=8, seed=2):
+    mesh = build_mesh(MeshSpec(data=2, expert=4))
+    lm = SwitchLM(mesh, CFG, num_experts=num_experts, top_k=1,
+                  capacity_factor=capacity_factor, router=router,
+                  aux_weight=0.0)
+    params = lm.init_params(jax.random.PRNGKey(7))
+    tx = optax.sgd(0.0)
+    opt_state = lm.init_opt_state(tx, params)
+    step = lm.make_train_step(tx, params, donate=False)
+    _, _, m = step(opt_state, params, _tokens(16, seed=seed))
+    return float(m["lm_loss"])
+
+
+def test_dropless_router_loss_parity_and_no_drops():
+    """The dropless router (PR 19) against top-1 Switch, same weights and
+    batch. (a) Parity: with capacity ample enough that Switch seats every
+    token too, both routers compute the same loss — dropless only widens
+    the dispatch buffer (padding rows contribute exact zeros), it never
+    reroutes. (b) The point: with a tight capacity factor Switch DROPS
+    tokens (its loss moves away from the seat-everything value) while
+    dropless — which has no capacity factor at all — still equals it."""
+    ample = _loss_once("switch", 16.0)   # C >= t_local: zero drops
+    dropless = _loss_once("dropless", 16.0)  # cf ignored by the router
+    np.testing.assert_allclose(dropless, ample, rtol=1e-6)
+    tight = _loss_once("switch", 0.25)   # C=1 vs mean load 4: real drops
+    assert abs(tight - ample) > 1e-6, (tight, ample)
+    np.testing.assert_allclose(_loss_once("dropless", 0.25), ample,
+                               rtol=1e-6)
+
+
+def test_router_validation():
+    with pytest.raises(ValueError, match="router"):
+        mesh = build_mesh(MeshSpec(data=2, expert=4))
+        SwitchLM(mesh, CFG, num_experts=8, router="topk-drop")

@@ -539,3 +539,59 @@ def test_compressed_bucket_key_cpu_defaults_only(isolated_autotune_table):
         autotune.bucket_record(param_bytes=1 << 20, world=8,
                                dtype=np.int8, bucket_bytes=1 << 19)
     assert not path.exists() or json.loads(path.read_text() or "{}") == {}
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_wq_bank_matmul_matches_per_expert_wq_matmul(bits):
+    """The expert-bank form (PR 19) is wq_matmul applied expert by
+    expert — bitwise, since each expert's rows run the identical fused
+    contraction. Also pins the widened-transient discipline: no f32
+    tensor of the WHOLE bank's shape appears in the jaxpr (each
+    expert's kernel widens alone)."""
+    rng = np.random.RandomState(3)
+    E, C, D, F = 4, 6, 16, 32
+    x = jnp.asarray(rng.randn(E, C, D).astype(np.float32))
+    bank = jnp.asarray(rng.randn(E, D, F).astype(np.float32))
+    q, scale = jax.vmap(
+        lambda k: quant.quantize_channelwise(k, bits=bits))(bank)
+    stored = jax.vmap(quant.pack_int4)(q) if bits == 4 else q
+    got = quant.wq_bank_matmul(x, stored, scale, bits=bits)
+    assert got.shape == (E, C, F)
+    for e in range(E):
+        ref = quant.wq_matmul(x[e], stored[e], scale[e], bits=bits)
+        assert np.array_equal(np.asarray(got[e]), np.asarray(ref)), e
+    jaxpr = jax.make_jaxpr(
+        lambda a, b, s: quant.wq_bank_matmul(a, b, s, bits=bits))(
+        x, stored, scale)
+    whole_bank = [v for eqn in walker.walk(jaxpr) for v in eqn.outvars
+                  if tuple(v.aval.shape) == (E, D, F)
+                  and v.aval.dtype == jnp.float32]
+    assert not whole_bank, "dequantized bank materialized at full width"
+
+
+def test_quantize_params_folds_expert_banks():
+    """quantize_params recognizes 3-D (E, d_in, d_out) bank kernels
+    under the WQ_BANKS names and emits per-expert qkernel+scale; the
+    f32 router projection is exempt (routing is precision-sensitive)."""
+    rng = np.random.RandomState(4)
+    params = {
+        "mlp": {
+            "router": {"kernel": rng.randn(16, 4).astype(np.float32)},
+            "w_in": {"kernel": rng.randn(4, 16, 32).astype(np.float32)},
+            "w_out": {"kernel": rng.randn(4, 32, 16).astype(np.float32)},
+        },
+    }
+    out = quant.quantize_params(params, bits=8)
+    assert out["mlp"]["w_in"]["qkernel"].shape == (4, 16, 32)
+    assert out["mlp"]["w_in"]["qkernel"].dtype == jnp.int8
+    assert out["mlp"]["w_in"]["scale"].shape == (4, 32)
+    assert out["mlp"]["w_out"]["scale"].shape == (4, 16)
+    assert out["mlp"]["router"]["kernel"].dtype == jnp.float32
+    # per-expert channelwise: bank slice e quantizes exactly like the
+    # 2-D kernel it is
+    q0, s0 = quant.quantize_channelwise(
+        jnp.asarray(params["mlp"]["w_in"]["kernel"][0]), bits=8)
+    assert np.array_equal(np.asarray(out["mlp"]["w_in"]["qkernel"][0]),
+                          np.asarray(q0))
+    assert np.array_equal(np.asarray(out["mlp"]["w_in"]["scale"][0]),
+                          np.asarray(s0))

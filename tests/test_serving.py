@@ -1472,3 +1472,174 @@ def test_kill_mid_snapshot_then_restore_bitwise(tmp_path, params):
         assert r["completions"][str(i)] == _oracle(
             CFG, params, i, 0.8, 10, prompts=prompts, max_new=[8, 8]), \
             f"req {i} diverged across the kill"
+
+# ---- expert-parallel MoE decode (PR 19) -------------------------------------
+
+MOE_CFG = dataclasses.replace(CFG, moe_experts=4, moe_capacity=2)
+
+
+@pytest.fixture(scope="module")
+def moe_params():
+    return Transformer(MOE_CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))["params"]
+
+
+@pytest.mark.parametrize("temp,top_k", [(0.0, None), (0.8, 10)],
+                         ids=["greedy", "sampled"])
+def test_moe_engine_matches_one_shot_bitwise(moe_params, temp, top_k):
+    """The MoE acceptance pin: router dispatch + capacity-bounded expert
+    contraction run INSIDE the fixed-slot serve programs, and every
+    completed stream still equals the request's solo one-shot run
+    exactly. The oracle decodes one token at a time (t=1 <= capacity,
+    so it can never overflow); the engine batches slots and may stall —
+    parity holding anyway is what degrade-to-overflow promises: a hot
+    expert costs TIME, never tokens."""
+    eng, _ = _serve(MOE_CFG, moe_params, temp=temp, top_k=top_k, slots=2,
+                    num_blocks=33, block_size=8, prefill_chunk=8)
+    got = eng.completions()
+    for i in range(len(PROMPTS)):
+        assert got[i] == _oracle(MOE_CFG, moe_params, i, temp, top_k), \
+            f"req {i}"
+    assert eng.sched.done == {0, 1, 2}
+    eng.sched.pool.check_leaks()
+    assert eng.live_blocks() == 0
+
+
+def test_moe_parity_through_eviction(moe_params):
+    """The forced-eviction geometry under the MoE model: preemption,
+    continuation re-prefill and capacity stalls compose, and every
+    stream still lands bitwise on its one-shot oracle."""
+    prompts = [np.array([3, 5, 7, 9, 11], np.int32),
+               np.array([2, 4, 6, 8, 10, 12, 14], np.int32)]
+    max_new = [40, 40]
+    eng, _ = _serve(MOE_CFG, moe_params, temp=0.7, top_k=12,
+                    prompts=prompts, max_new=max_new, slots=2,
+                    num_blocks=9, block_size=8, prefill_chunk=8)
+    assert eng.sched.preemptions >= 1
+    got = eng.completions()
+    for i in range(2):
+        assert got[i] == _oracle(MOE_CFG, moe_params, i, 0.7, 12,
+                                 prompts=prompts, max_new=max_new), \
+            f"req {i} diverged across eviction"
+    eng.sched.pool.check_leaks()
+
+
+def test_moe_wq8_expert_banks_parity(moe_params):
+    """Weight-only int8 expert banks: quantize_params folds the (E, d,
+    ff) bank kernels to per-expert qkernel+scale, the engine decodes
+    through wq_bank_matmul, and streams still match the one-shot oracle
+    running the SAME quantized model bitwise — quantization changes the
+    model, never the serving discipline."""
+    from distributed_tensorflow_guide_tpu.ops import quant
+
+    wq_cfg = dataclasses.replace(MOE_CFG, weight_dtype="int8")
+    wq_params = quant.quantize_params(moe_params, bits=8)
+    eng, _ = _serve(wq_cfg, wq_params, temp=0.8, top_k=10, slots=2,
+                    num_blocks=33, block_size=8, prefill_chunk=8)
+    got = eng.completions()
+    for i in range(len(PROMPTS)):
+        assert got[i] == _oracle(wq_cfg, wq_params, i, 0.8, 10), f"req {i}"
+    eng.sched.pool.check_leaks()
+    # the routed banks really are stored int8 (f32 router exempt)
+    mlp = wq_params["block_0"]["mlp"]
+    assert mlp["w_in"]["qkernel"].dtype == jnp.int8
+    assert mlp["w_out"]["qkernel"].dtype == jnp.int8
+    router_k = mlp["router"]["kernel"]
+    assert getattr(router_k, "value", router_k).dtype == jnp.float32
+
+
+def test_moe_capacity_degrade_emits_census_and_stalls(moe_params):
+    """capacity=1 with two live slots forces contention: the engine must
+    report real stalls and overflow WITHOUT corrupting a stream, and the
+    per-expert census must balance exactly — every routed token-slot is
+    either seated (load) or overflowed (stall + retry), across all
+    launches:  sum(load) + sum(overflow) ==
+    L * (prompt tokens + (max_new - 1) decode ticks + stalled ticks)."""
+    cap1 = dataclasses.replace(CFG, moe_experts=4, moe_capacity=1)
+    eng, _ = _serve(cap1, moe_params, temp=0.8, top_k=10, slots=2,
+                    num_blocks=33, block_size=8, prefill_chunk=8)
+    got = eng.completions()
+    for i in range(len(PROMPTS)):
+        assert got[i] == _oracle(cap1, moe_params, i, 0.8, 10), f"req {i}"
+    moe = eng.health()["moe"]
+    assert moe["stall_slot_ticks"] >= 1  # contention really happened
+    assert moe["stall_ticks"] >= 1
+    # overflow counts per-layer routing events; every stalled slot
+    # overflowed in at least one layer
+    assert sum(moe["expert_overflow"]) >= moe["stall_slot_ticks"]
+    L = cap1.num_layers
+    routed = (sum(len(p) for p in PROMPTS)
+              + sum(mn - 1 for mn in MAX_NEW)
+              + moe["stall_slot_ticks"])
+    assert (sum(moe["expert_load"]) + sum(moe["expert_overflow"])
+            == L * routed)
+    eng.sched.pool.check_leaks()
+
+
+def test_moe_health_absorbs_into_metrics(moe_params):
+    """health()["moe"] -> the declared dtg_moe_* metric names, one
+    labeled series per expert (obs/metrics.py absorb_engine)."""
+    from distributed_tensorflow_guide_tpu.obs import metrics
+
+    cap1 = dataclasses.replace(CFG, moe_experts=4, moe_capacity=1)
+    eng, _ = _serve(cap1, moe_params, temp=0.8, top_k=10, slots=2,
+                    num_blocks=33, block_size=8, prefill_chunk=8)
+    reg = metrics.Registry()
+    metrics.absorb_engine(reg, eng.health())
+    text = reg.to_prometheus()
+    assert 'dtg_moe_expert_load_total{expert="0"}' in text
+    assert 'dtg_moe_expert_overflow_total{expert="3"}' in text
+    assert "dtg_moe_stall_slot_ticks_total" in text
+    assert "dtg_moe_stall_ticks_total" in text
+
+
+def test_moe_engine_kill_restore_resumes_bitwise(moe_params, tmp_path):
+    """Snapshot/restore under the MoE model: a fresh engine restored
+    from the snapshot finishes every stream bitwise (residents
+    re-prefill as continuations; the dropless prefill path re-seats
+    them without drops), exactly like the dense pin."""
+    kw = dict(slots=2, num_blocks=33, block_size=8, prefill_chunk=8,
+              temperature=0.8, top_k=10,
+              snapshot_dir=str(tmp_path / "snap"))
+    eng = ServeEngine(MOE_CFG, moe_params, **kw)
+    _submit_all(eng)
+    for _ in range(7):
+        eng.step()
+    label = eng.save_snapshot()
+    assert label is not None
+    for _ in range(3):
+        eng.step()
+    pre = eng.completions()
+    eng.close()
+
+    eng2 = ServeEngine(MOE_CFG, moe_params, **kw)
+    assert eng2.restore_latest_snapshot() == label
+    eng2.run()
+    got = eng2.completions()
+    for i in range(len(PROMPTS)):
+        assert got[i] == _oracle(MOE_CFG, moe_params, i, 0.8, 10), \
+            f"req {i}"
+        assert pre[i] == got[i][:len(pre[i])]
+    eng2.sched.pool.check_leaks()
+    assert eng2.live_blocks() == 0
+    eng2.close()
+
+
+def test_non_moe_configs_compile_identical_programs(params):
+    """The zero-regression gate in miniature: build_step_fns for a
+    non-MoE config takes the historical branch — the jaxprs contain no
+    router, no expert contraction, no moe_stats plumbing."""
+    fns = build_step_fns(CFG, slots=2, num_blocks=33, block_size=8,
+                         prefill_chunk=8)
+    assert not fns.moe
+    from distributed_tensorflow_guide_tpu.serve.engine import (
+        paged_cache_shapes,
+    )
+
+    pool = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                        paged_cache_shapes(fns.cfg, 2))
+    jaxpr = jax.make_jaxpr(fns.decode)(
+        params, pool, jnp.zeros((2, fns.n_blk), jnp.int32),
+        jnp.zeros((2,), jnp.int32), jnp.zeros((2,), jnp.int32),
+        jnp.zeros((2, 2), jnp.uint32))
+    assert "moe" not in str(jaxpr)
