@@ -77,6 +77,19 @@ longest cached prefix, pinned by a repeat wave. Reported:
 split, ``prefix_route_hits`` and the migrated-stream bitwise verdict —
 placement moves COST, never CONTENT.
 
+``--fleet-chaos`` adds the fleet-under-fire leg (PR 20): a seeded
+``random_fleet`` storm (replica hard-crashes, watchdog stalls, torn
+migration handoffs) burns the same workload on a deterministic
+per-tick virtual clock, against a storm-free clean leg. Reported:
+``recovery_mttr_s`` (replica down -> routable again),
+``goodput_under_chaos_frac`` (clean span / chaos span),
+``zero_dropped_streams`` (every stream completes bitwise vs the clean
+leg), and the fleet event-signature determinism pin (two runs of the
+same seed, equal signatures). ``--fleet-restore`` adds the mid-storm
+kill: at 1/3 of the workload's tokens the fleet snapshots through the
+PR-5 manifested/CRC path and a fresh fleet restores and finishes —
+still bitwise vs the clean leg.
+
 ``--moe E`` adds the MoE A/B phase (PR 19): the model is rebuilt with E
 routed experts at the dense FFN width (top-1 routing = matched ACTIVE
 params per token, E x the held weights) and the top-rate arrival mix
@@ -189,6 +202,19 @@ def main() -> None:
                          "prefill/decode roles and ships each stream's "
                          "KV blocks prefill->decode at the phase flip "
                          "(counted and priced against the DCN roofline)")
+    ap.add_argument("--fleet-chaos", action="store_true",
+                    help="fleet-under-fire leg (PR 20): a seeded "
+                         "replica_crash/replica_stall/migration_torn "
+                         "storm over the fleet, reporting "
+                         "recovery_mttr_s, goodput_under_chaos_frac and "
+                         "zero_dropped_streams, with the fleet event "
+                         "signature pinned deterministic per seed "
+                         "(implies --fleet 2 when --fleet is off)")
+    ap.add_argument("--fleet-restore", action="store_true",
+                    help="with --fleet-chaos: kill the fleet at 1/3 of "
+                         "its tokens mid-storm, fleet-snapshot, restore "
+                         "into a fresh fleet and finish — every stream "
+                         "must complete bitwise vs the clean leg")
     ap.add_argument("--fleet-prefix", action="store_true",
                     help="fleet-level prefix routing: requests route to "
                          "the replica holding their longest cached "
@@ -265,6 +291,11 @@ def main() -> None:
     if args.moe == 1:
         raise SystemExit("--moe needs >= 2 experts (1 expert is the "
                          "dense model)")
+    if args.fleet_restore and not args.fleet_chaos:
+        raise SystemExit("--fleet-restore requires --fleet-chaos (it is "
+                         "the storm's mid-run kill/restore leg)")
+    if args.fleet_chaos and not args.fleet:
+        args.fleet = 2  # the storm needs a fleet to burn
     cfg = dataclasses.replace(
         cfg,
         kv_dtype="int8" if args.kv_dtype == "int8" else None,
@@ -1171,6 +1202,166 @@ def main() -> None:
                 "bound": r["bound"],
             }
         fl.close()
+
+        # ---- fleet under fire (PR 20) --------------------------------
+        if args.fleet_chaos:
+            import tempfile
+
+            from distributed_tensorflow_guide_tpu.obs import (
+                events as obs_events,
+            )
+            from distributed_tensorflow_guide_tpu.testing.chaos import (
+                FaultSchedule,
+            )
+
+            def chaos_fleet(storm=None, recorder=None,
+                            snapshot_dir=None):
+                return FleetScheduler(
+                    serve_cfg, params, replicas=args.fleet,
+                    roles=args.fleet_roles,
+                    slots=args.slots, num_blocks=args.num_blocks,
+                    block_size=args.block_size,
+                    prefill_chunk=args.prefill_chunk,
+                    temperature=0.0, adapters=bank,
+                    prefix_cache=args.fleet_prefix,
+                    host_blocks=args.host_blocks,
+                    fleet_chaos=storm, recorder=recorder,
+                    snapshot_dir=snapshot_dir)
+
+            def resume_det(flc, *, dt=0.01, stop_tokens=None, now=0.0,
+                           emitted=0):
+                """Deterministic virtual clock for the chaos legs:
+                every tick charges a FIXED dt (idle ticks fast-forward
+                to the next arrival), so two seeded runs of the same
+                storm walk the same tick sequence — what makes the
+                event signature pinnable.  Stops once ``stop_tokens``
+                have been emitted (the kill point)."""
+                wedged = 0
+                while flc._has_work():
+                    evs, kind = flc.step(now)
+                    now += dt
+                    if kind == "idle":
+                        wedged += 1
+                        if wedged > 256:
+                            raise RuntimeError("fleet wedged under "
+                                               "chaos: no progress")
+                        nxt = flc.next_arrival()
+                        if nxt is not None:
+                            now = max(now, nxt)
+                        continue
+                    wedged = 0
+                    emitted += sum(1 for e in evs
+                                   if e.status == "ok" and e.token >= 0)
+                    if (stop_tokens is not None
+                            and emitted >= stop_tokens):
+                        break
+                return now, emitted
+
+            def drive_det(flc, workload, **kw):
+                for rid, arr, toks, M, *rest in workload:
+                    flc.submit(Request(
+                        rid=rid, prompt=toks, max_new_tokens=M,
+                        rng=jax.random.PRNGKey(rid % (1 << 20)),
+                        arrival=arr, adapter=adapter_of(rid),
+                        tenant=rest[0] if rest else 0))
+                return resume_det(flc, **kw)
+
+            def storm():
+                return FaultSchedule.random_fleet(
+                    args.seed, max_position=24, replicas=args.fleet,
+                    n_faults=4)
+
+            wl_fc = make_workload(rate_f, args.requests, tag=63)
+            total_tokens = sum(w[3] for w in wl_fc)
+
+            # clean leg: same workload, no storm — the bitwise baseline
+            # and the goodput denominator
+            fl_clean = chaos_fleet()
+            span_clean, _ = drive_det(fl_clean, wl_fc)
+            comp_clean = fl_clean.completions()
+            fl_clean.check_leaks()
+            fl_clean.close()
+
+            def chaos_leg():
+                rec_fc = obs_events.FlightRecorder(capacity=1 << 16)
+                flc = chaos_fleet(storm=storm(), recorder=rec_fc)
+                span, _ = drive_det(flc, wl_fc)
+                comp = flc.completions()
+                h = flc.health()
+                flc.check_leaks()
+                flc.close()
+                return comp, span, h, [
+                    e for e in rec_fc.events()
+                    if str(e.kind).startswith("fleet.")]
+
+            comp_c, span_c, h_c, ev_c = chaos_leg()
+            _, _, _, ev_c2 = chaos_leg()  # the determinism pin
+            deterministic = (obs_events.signature(ev_c)
+                             == obs_events.signature(ev_c2))
+
+            # MTTR: replica down (crash/stall/ejection) -> that replica
+            # recovered, on the deterministic virtual clock
+            mttrs, downs = [], {}
+            for e in ev_c:
+                p = e.payload or {}
+                if e.kind in ("fleet.replica_crash",
+                              "fleet.replica_stall",
+                              "fleet.replica_ejected"):
+                    downs.setdefault(p.get("replica"), e.t)
+                elif e.kind == "fleet.replica_recovered":
+                    t0 = downs.pop(p.get("replica"), None)
+                    if t0 is not None:
+                        mttrs.append(e.t - t0)
+            zero_dropped = (
+                sorted(comp_c) == sorted(comp_clean)
+                and all(comp_c[r] == comp_clean[r] for r in comp_clean))
+            fleet_extras.update({
+                "fleet_chaos_seed": args.seed,
+                "recovery_mttr_s": (round(sum(mttrs) / len(mttrs), 4)
+                                    if mttrs else None),
+                "recoveries_measured": len(mttrs),
+                "goodput_under_chaos_frac": round(
+                    span_clean / max(span_c, 1e-9), 3),
+                "zero_dropped_streams": bool(zero_dropped),
+                "fleet_chaos_bitwise_identical": bool(zero_dropped),
+                "fleet_chaos_deterministic": bool(deterministic),
+                "fleet_replica_crashes": h_c["replica_crashes"],
+                "fleet_replica_stalls": h_c["replica_stalls"],
+                "fleet_breaker_ejections": h_c["breaker_ejections"],
+                "fleet_breaker_probes": h_c["breaker_probes"],
+                "fleet_breaker_recoveries": h_c["breaker_recoveries"],
+                "fleet_migration_dups_dropped":
+                    h_c["migration_dups_dropped"],
+            })
+
+            # mid-storm kill at 1/3 tokens -> fleet snapshot -> restore
+            # into a fresh fleet -> finish: still bitwise vs clean
+            if args.fleet_restore:
+                snapdir = tempfile.mkdtemp(prefix="fleet_snap_")
+                flk = chaos_fleet(storm=storm(), snapshot_dir=snapdir)
+                now_k, emitted_k = drive_det(
+                    flk, wl_fc, stop_tokens=max(1, total_tokens // 3))
+                label = flk.save_snapshot()
+                crashes_at_kill = flk.replica_crashes
+                flk.close()
+                flr = chaos_fleet(snapshot_dir=snapdir)
+                restored = flr.restore_latest_snapshot()
+                resume_det(flr, now=now_k, emitted=emitted_k)
+                comp_r = flr.completions()
+                restore_bitwise = (
+                    sorted(comp_r) == sorted(comp_clean)
+                    and all(comp_r[r] == comp_clean[r]
+                            for r in comp_clean))
+                flr.check_leaks()
+                flr.close()
+                fleet_extras.update({
+                    "fleet_restore_label": restored,
+                    "fleet_restore_saved_label": label,
+                    "fleet_restore_kill_tokens": emitted_k,
+                    "fleet_restore_crashes_before_kill": crashes_at_kill,
+                    "fleet_restore_bitwise_identical":
+                        bool(restore_bitwise),
+                })
 
     # ---- MoE A/B phase (PR 19) -------------------------------------------
     moe_extras = {}

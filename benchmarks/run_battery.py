@@ -275,6 +275,22 @@ BATTERY: list[tuple[str, list[str], int]] = [
       "--decode-impl", "dense", "--weight-dtype", "model",
       "--host-blocks", "0", "--fleet", "2",
       "--fleet-prefix"], 1800),
+    # fleet under fire (PR 20): one knob each off serve_fleet — the
+    # seeded crash/stall/torn storm (breaker, re-anchoring, exactly-once
+    # adoption, MTTR + goodput-under-chaos + zero-dropped-streams), then
+    # + the mid-storm fleet kill/snapshot/restore leg
+    ("serve_fleet_chaos",
+     ["benchmarks/bench_serving.py", "--mode", "static",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0", "--fleet", "2",
+      "--fleet-chaos"], 1800),
+    ("serve_fleet_restore",
+     ["benchmarks/bench_serving.py", "--mode", "static",
+      "--prefill-chunk", "32", "--kv-dtype", "model",
+      "--decode-impl", "dense", "--weight-dtype", "model",
+      "--host-blocks", "0", "--fleet", "2",
+      "--fleet-chaos", "--fleet-restore"], 1800),
     # MoE serving (PR 19): one knob each — serve_continuity + the MoE
     # A/B phase (expert-parallel decode vs dense at matched active
     # params), then + int8 expert banks (the wq8 diet applied to the
@@ -391,6 +407,11 @@ ROW_PROGRAMS: dict[str, str] = {
     "serve_fleet": "serve_decode_step",
     "serve_disagg": "serve_kv_block_transfer_dcn",
     "serve_fleet_prefix": "serve_decode_step",
+    # the chaos rows compile NOTHING new: crash-replacement replicas and
+    # restored fleets hit the build_step_fns memo, so both join to the
+    # same decode program as serve_fleet
+    "serve_fleet_chaos": "serve_decode_step",
+    "serve_fleet_restore": "serve_decode_step",
     "moe_dropless": "moe_dropless_train_step",
     "serve_moe": "serve_decode_step_moe",
     "serve_moe_wq8": "serve_decode_step_moe_wq8",

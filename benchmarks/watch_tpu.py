@@ -65,6 +65,8 @@ FIRST_WINDOW = [
     "serve_fleet",             # scale-out fleet A/B (PR 18),
     "serve_disagg",            # + disaggregated prefill/decode roles,
     "serve_fleet_prefix",      # + fleet-level prefix routing
+    "serve_fleet_chaos",       # fleet under fire (PR 20): crash storm,
+    "serve_fleet_restore",     # + mid-storm fleet snapshot/restore
     "serve_moe",               # expert-parallel MoE decode A/B (PR 19),
     "serve_moe_wq8",           # + int8 expert banks
     "moe_dropless",            # dropless router A/B vs moe_lm (PR 19)

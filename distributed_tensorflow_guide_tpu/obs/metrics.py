@@ -195,7 +195,7 @@ def absorb_engine(reg: Registry, health: dict) -> None:
               "prefix_evictions", "spill_out_blocks", "spill_in_blocks",
               "spill_d2h_bytes", "spill_h2d_bytes",
               "spill_prefetched_blocks", "spill_resumes",
-              "swapin_tokens_saved"):
+              "swapin_tokens_saved", "launch_failures"):
         if k in health:
             reg.counter(f"dtg_serve_{k}_total").set_total(health[k])
     if "ticks" in health:
@@ -231,11 +231,28 @@ def absorb_fleet(reg: Registry, health: dict) -> None:
     reg.gauge("dtg_fleet_generation").set(health.get("generation", 0))
     for k in ("shed", "completed", "migrations", "migration_bytes",
               "replicas_shed", "replicas_regrown", "prefix_route_hits",
-              "prefix_route_hit_tokens"):
+              "prefix_route_hit_tokens",
+              # the PR-20 reliability plane: crash/stall recoveries, the
+              # breaker's eject/probe/recover cycle, step-boundary
+              # faults, exactly-once adoption drops, autoscale actions
+              "replica_crashes", "replica_stalls", "breaker_ejections",
+              "breaker_probes", "breaker_recoveries", "replica_faults",
+              "launch_failures", "migration_dups_dropped",
+              "autoscale_added", "autoscale_retired"):
         if k in health:
             reg.counter(f"dtg_fleet_{k}_total").set_total(health[k])
     if "migration_secs" in health:
         reg.gauge("dtg_fleet_migration_s").set(health["migration_secs"])
+    if "stalled" in health:
+        reg.gauge("dtg_fleet_stalled_replicas").set(
+            len(health["stalled"]))
+    if "draining" in health:
+        reg.gauge("dtg_fleet_draining_replicas").set(
+            len(health["draining"]))
+    autoscale = health.get("autoscale")
+    if autoscale:
+        reg.gauge("dtg_fleet_autoscale_target").set(
+            autoscale.get("target_replicas", 0))
     for tenant, c in (health.get("tenants") or {}).items():
         for k, v in c.items():
             reg.counter(f"dtg_fleet_tenant_{k}_total",
@@ -244,12 +261,17 @@ def absorb_fleet(reg: Registry, health: dict) -> None:
         labels = {"replica": str(i), "role": str(h.get("role", ""))}
         reg.gauge("dtg_fleet_replica_live", labels=labels).set(
             1.0 if h.get("live") else 0.0)
+        br = h.get("breaker")
+        if br:
+            reg.gauge("dtg_fleet_replica_breaker_open",
+                      labels=labels).set(
+                0.0 if br.get("state") == "closed" else 1.0)
         for k in ("resident", "queued", "live_blocks"):
             if k in h:
                 reg.gauge(f"dtg_fleet_replica_{k}",
                           labels=labels).set(h[k])
         for k in ("completed", "shed", "preemptions",
-                  "migrated_out", "migrated_in"):
+                  "migrated_out", "migrated_in", "launch_failures"):
             if k in h:
                 reg.counter(f"dtg_fleet_replica_{k}_total",
                             labels=labels).set_total(h[k])

@@ -484,6 +484,9 @@ class ServeEngine:
         self.retry_attempts = retry_attempts
         self.retry_base_delay_s = retry_base_delay_s
         self._injected_exc = 0  # pending chaos launch failures
+        # every failed launch ATTEMPT (retried-and-recovered ones
+        # included) — what the fleet breaker and dtg_serve metrics read
+        self.launch_failures = 0
         self._pressure_holds: list[tuple[float, list[int]]] = []
         self._tick = 0
         self._ttft_ewma: float | None = None  # predicted-TTFT shed gate
@@ -740,24 +743,27 @@ class ServeEngine:
         docs/serving.md spells out."""
 
         def attempt():
-            if self._injected_exc:
-                self._injected_exc -= 1
-                from distributed_tensorflow_guide_tpu.testing.chaos import (
-                    ChaosInjectedError,
-                )
-                raise ChaosInjectedError(
-                    f"chaos: injected serve step exception ({tag})")
-            wd = self._watchdog
-            if wd is None:
-                return fn()
-            wd.arm(tag, self._step_deadline_s)
             try:
-                return fn()
-            except KeyboardInterrupt:
-                wd.check()  # a trip becomes the clean, retriable error
+                if self._injected_exc:
+                    self._injected_exc -= 1
+                    from distributed_tensorflow_guide_tpu.testing.chaos \
+                        import ChaosInjectedError
+                    raise ChaosInjectedError(
+                        f"chaos: injected serve step exception ({tag})")
+                wd = self._watchdog
+                if wd is None:
+                    return fn()
+                wd.arm(tag, self._step_deadline_s)
+                try:
+                    return fn()
+                except KeyboardInterrupt:
+                    wd.check()  # trip becomes the clean, retriable error
+                    raise
+                finally:
+                    wd.disarm()
+            except Exception:
+                self.launch_failures += 1
                 raise
-            finally:
-                wd.disarm()
 
         return retry_with_backoff(
             attempt, attempts=self.retry_attempts,
@@ -991,6 +997,7 @@ class ServeEngine:
             "tenants": {t: dict(c) for t, c in sorted(sd.tenants.items())},
             "last_tick_s": self.last_tick_s,
             "ticks": self._tick,
+            "launch_failures": self.launch_failures,
             **({"moe": {
                 "expert_load": [int(x) for x in self._moe_load],
                 "expert_overflow": [int(x) for x in self._moe_overflow],

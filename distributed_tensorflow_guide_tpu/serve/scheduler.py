@@ -1076,6 +1076,43 @@ class Scheduler:
                           t=self.now)
         return record
 
+    @staticmethod
+    def continuation_record(*, rid: int, prompt, budget: int, rng,
+                            emitted=(), tenant: int = 0, adapter: int = 0,
+                            first_emit: bool | None = None,
+                            meta=None,
+                            arrival: float = float("-inf")) -> dict:
+        """Build an :meth:`attach_stream`-compatible record WITHOUT a
+        source scheduler — the supervisor-side continuation transform
+        for a replica that died with no orderly :meth:`detach_stream`.
+        ``prompt`` must already be the continuation prompt (the base
+        prompt at dispatch plus every token observed since), ``budget``
+        the remaining token budget, and ``emitted`` the stream's FULL
+        emitted history (it travels with the record so fleet-merged
+        completions stay a disjoint sum).  KV never survives a hard
+        crash, so the record carries no payloads: the adopter
+        re-prefills, which position-derived sampling keys make bitwise
+        identical to the uninterrupted stream."""
+        if budget < 1:
+            raise ValueError(
+                f"rid {rid}: a continuation needs budget >= 1, got "
+                f"{budget} (an exhausted stream is terminal, not live)")
+        emitted = [int(t) for t in emitted]
+        return {
+            "rid": int(rid),
+            "prompt": np.asarray(prompt, np.int32).reshape(-1),
+            "budget": int(budget),
+            "rng": np.asarray(rng, np.uint32),
+            "arrival": float(arrival),
+            "tenant": int(tenant), "adapter": int(adapter),
+            "written": 0, "pending": 0,
+            "emitted": emitted,
+            "first_emit": (bool(emitted) if first_emit is None
+                           else bool(first_emit)),
+            "meta": None if meta is None else [meta[0], meta[1], meta[2]],
+            "payloads": [], "payload_bytes": 0,
+        }
+
     def attach_stream(self, record: dict) -> None:
         """Adopt a migrated stream: install its identity maps and queue
         the continuation at the FRONT (it was already served elsewhere).

@@ -65,6 +65,31 @@ waiting); draw a serving storm with :meth:`random_serve` instead:
                           catches it) — the restore ladder falls back
 =======================  ====================================================
 
+Fleet-kind faults target the scale-out tier (serve/fleet.py) and fire at
+deterministic FLEET tick positions — the fleet drains them via
+:meth:`FaultSchedule.take_fleet` at the top of each fleet ``step``.  They
+model the failure domain ABOVE one engine: a whole replica dying or
+wedging, and the migration seam tearing mid-handoff.  Like the world and
+serve kinds they are excluded from :meth:`FaultSchedule.random`'s default
+draw and pass through ``wrap_step``/``inject_data`` untouched; draw a
+fleet storm with :meth:`random_fleet`:
+
+==================  =========================================================
+``replica_crash``   replica ``param`` dies mid-tick with NO orderly
+                    ``detach_stream`` (its KV and engine object are gone);
+                    the fleet reconstructs its residents from the fleet's
+                    own admission ledger and re-anchors them queue-front
+``replica_stall``   replica ``param`` wedges (the watchdog's tick-deadline
+                    verdict, delivered deterministically); it is excluded
+                    from routing while its streams re-anchor host-side,
+                    and rejoins after the fleet's stall-recovery window
+``migration_torn``  the NEXT migration / re-anchor handoff record is
+                    duplicated in flight (a torn handoff: the sender
+                    cannot know the record landed, so it resends) — the
+                    fleet's (rid, generation) adoption ledger must swallow
+                    the duplicate exactly once
+==================  =========================================================
+
 Mid-save process kills are process-level, not stream-level: use
 ``runtime.multiprocess.MultiProcessRunner.kill`` directly (see the chaos
 tests). Every fault is one-shot — after it fires once it never fires again,
@@ -103,7 +128,11 @@ SERVE_STORM_KINDS = ("serve_step_exception", "client_abandon",
                      "arrival_burst", "pool_pressure")
 SERVE_SNAPSHOT_KINDS = ("snapshot_truncate", "snapshot_corrupt")
 SERVE_KINDS = SERVE_STORM_KINDS + SERVE_SNAPSHOT_KINDS
-KINDS = INJECTABLE_KINDS + WORLD_KINDS + SERVE_KINDS
+# fleet kinds fire inside FleetScheduler.step at fleet-tick positions —
+# the replica-targeted ones carry a replica index in param (mod'd by the
+# fleet width, mirroring the world kinds' slice targeting)
+FLEET_KINDS = ("replica_crash", "replica_stall", "migration_torn")
+KINDS = INJECTABLE_KINDS + WORLD_KINDS + SERVE_KINDS + FLEET_KINDS
 
 
 class ChaosInjectedError(RuntimeError):
@@ -154,6 +183,12 @@ class Fault:
                 raise ValueError(
                     f"{self.kind} needs param = a positive count "
                     f"(requests / blocks), got {self.param!r}")
+        if self.kind in ("replica_crash", "replica_stall"):
+            # param targets the replica index (mod fleet width at fire)
+            if self.param != int(self.param) or self.param < 0:
+                raise ValueError(
+                    f"{self.kind} needs param = a non-negative replica "
+                    f"index, got {self.param!r}")
 
     @property
     def slice_id(self) -> int:
@@ -349,6 +384,44 @@ class FaultSchedule:
                                 tenant=_tenant(kind)))
         return cls(faults)
 
+    @classmethod
+    def random_fleet(cls, seed: int, *, max_position: int,
+                     replicas: int,
+                     kinds: Sequence[str] = FLEET_KINDS,
+                     n_faults: int = 3,
+                     min_position: int = 1) -> "FaultSchedule":
+        """Deterministic-in-``seed`` fleet storm: ``n_faults`` distinct
+        FLEET-tick positions in ``[min_position, max_position)``, kinds
+        drawn uniformly from ``kinds`` (defaults to all three fleet
+        kinds).  Replica-targeted kinds draw their target from
+        ``[0, replicas)``; ``migration_torn`` is param-free (rng draws
+        happen only for replica-targeted faults, keeping schedules with
+        different kind mixes independently stable).  Same seed →
+        identical schedule, always."""
+        bad = [k for k in kinds if k not in FLEET_KINDS]
+        if bad:
+            raise ValueError(f"non-fleet kinds in random_fleet: {bad}")
+        if replicas < 1:
+            raise ValueError(f"need >= 1 replica to target, "
+                             f"got {replicas}")
+        if max_position - min_position < n_faults:
+            raise ValueError(
+                f"cannot place {n_faults} faults in "
+                f"[{min_position}, {max_position})")
+        rng = np.random.RandomState(seed)
+        positions = rng.choice(
+            np.arange(min_position, max_position), size=n_faults,
+            replace=False,
+        )
+        chosen = rng.choice(len(kinds), size=n_faults)
+        faults = []
+        for p, k in zip(positions, chosen):
+            kind = kinds[int(k)]
+            param = (0.0 if kind == "migration_torn"
+                     else float(rng.randint(0, replicas)))
+            faults.append(Fault(kind, int(p), param))
+        return cls(faults)
+
     @property
     def pending(self) -> list[Fault]:
         return sorted(self._pending, key=lambda f: (f.position, f.kind))
@@ -370,6 +443,19 @@ class FaultSchedule:
         schedule only decides *when*, mirroring the world-kind split."""
         return self._take(tick, SERVE_KINDS)
 
+    def fleet_events(self) -> list[Fault]:
+        """Pending fleet-kind faults, soonest first — what the fleet has
+        yet to absorb (tests assert this drains to [] at run end)."""
+        return [f for f in self.pending if f.kind in FLEET_KINDS]
+
+    def take_fleet(self, tick: int) -> list[Fault]:
+        """Consume (one-shot) the fleet-kind faults due at fleet tick
+        ``tick``.  :class:`~..serve.fleet.FleetScheduler` calls this at
+        the top of every fleet ``step`` — the mechanism (crash
+        reconstruction, stall exclusion, torn-handoff duplication) lives
+        in the fleet, the schedule only decides *when*."""
+        return self._take(tick, FLEET_KINDS)
+
     def fire(self, fault: Fault) -> None:
         """Mark an externally-applied fault fired (one-shot bookkeeping
         for the world kinds, whose mechanism lives in the supervisor, not
@@ -382,8 +468,16 @@ class FaultSchedule:
         self._record(fault)
 
     def _take(self, position: int, kinds: Sequence[str]) -> list[Fault]:
-        due = [f for f in self._pending
-               if f.position == position and f.kind in kinds]
+        # kind-sorted, NOT set-iteration order: _pending is a set and
+        # Fault.kind is a str, so under hash randomization two faults
+        # due at the same position would fire in a process-dependent
+        # order (a torn handoff armed before vs after a same-tick crash
+        # is a different storm) — sorting makes co-positioned faults
+        # deterministic across processes
+        due = sorted(
+            (f for f in self._pending
+             if f.position == position and f.kind in kinds),
+            key=lambda f: (f.kind, f.param))
         for f in due:
             self._pending.discard(f)
             self.fired.append(f)

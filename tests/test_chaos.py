@@ -416,3 +416,100 @@ def test_injectors_never_consume_serve_kinds(tmp_path):
     for _ in range(3):
         state, _ = step(state, next(data))
     assert len(sched.serve_events()) == 2 and not sched.fired
+
+# ---- fleet fault kinds (PR 20) ----------------------------------------------
+# fleet kinds fire inside FleetScheduler.step via take_fleet() — the
+# schedule does the seeded planning + one-shot bookkeeping, pinned here;
+# the fleet-side crash-equivalence pins (hard-crash bitwise, torn-handoff
+# exactly-once, breaker lifecycle) live in tests/test_fleet_chaos.py.
+
+
+def test_fleet_kinds_validate_params():
+    Fault("migration_torn", 3)  # param-free
+    assert Fault("replica_crash", 3, 1.0).param == 1.0
+    assert Fault("replica_stall", 4, 0.0).param == 0.0
+    with pytest.raises(ValueError, match="replica"):
+        Fault("replica_crash", 3, -1.0)
+    with pytest.raises(ValueError, match="replica"):
+        Fault("replica_stall", 3, 1.5)  # fractional index
+
+
+def test_random_fleet_deterministic_and_fleet_only():
+    from distributed_tensorflow_guide_tpu.testing.chaos import FLEET_KINDS
+
+    a = FaultSchedule.random_fleet(5, max_position=40, replicas=3)
+    b = FaultSchedule.random_fleet(5, max_position=40, replicas=3)
+    assert a.faults == b.faults
+    c = FaultSchedule.random_fleet(6, max_position=40, replicas=3)
+    assert a.faults != c.faults
+    for seed in range(8):
+        s = FaultSchedule.random_fleet(seed, max_position=40, replicas=3)
+        assert all(f.kind in FLEET_KINDS for f in s.faults)
+        # replica-targeted params stay in range; torn is param-free
+        assert all(0 <= f.param < 3 for f in s.faults)
+    with pytest.raises(ValueError, match="non-fleet"):
+        FaultSchedule.random_fleet(0, max_position=40, replicas=2,
+                                   kinds=("step_exception",))
+    with pytest.raises(ValueError, match="replica"):
+        FaultSchedule.random_fleet(0, max_position=40, replicas=0)
+    with pytest.raises(ValueError, match="cannot place"):
+        FaultSchedule.random_fleet(0, max_position=3, replicas=2,
+                                   n_faults=5)
+
+
+def test_take_fleet_is_one_shot_and_position_targeted():
+    f2 = Fault("replica_crash", 2, 0.0)
+    f5 = Fault("migration_torn", 5)
+    sched = FaultSchedule([f2, f5, Fault("serve_step_exception", 2)])
+    assert sched.fleet_events() == [f2, f5]
+    assert sched.take_fleet(0) == []
+    assert sched.take_fleet(2) == [f2]
+    assert sched.take_fleet(2) == []  # one-shot
+    assert sched.fleet_events() == [f5]
+    # the co-positioned serve-side fault is NOT consumed by the fleet...
+    assert any(f.kind == "serve_step_exception" for f in sched.pending)
+    # ...and take_serve at the torn position leaves the fleet fault alone
+    assert sched.take_serve(5) == []
+    assert sched.fleet_events() == [f5]
+
+
+def test_take_orders_copositioned_faults_deterministically():
+    """Two faults due at the same tick must fire in kind order, not
+    set-iteration order — under hash randomization the latter is
+    process-dependent, and a torn handoff armed before vs after a
+    same-tick crash is a different storm."""
+    for _ in range(4):
+        sched = FaultSchedule([Fault("replica_crash", 3, 1.0),
+                               Fault("migration_torn", 3),
+                               Fault("replica_stall", 3, 0.0)])
+        taken = sched.take_fleet(3)
+        assert [f.kind for f in taken] == [
+            "migration_torn", "replica_crash", "replica_stall"]
+
+
+def test_random_default_draws_exclude_fleet_kinds():
+    """random()'s and random_serve()'s default draws must never emit a
+    fleet kind — those fire only through FleetScheduler.take_fleet, and
+    a single-engine storm schedule containing one would never drain."""
+    from distributed_tensorflow_guide_tpu.testing.chaos import FLEET_KINDS
+
+    for seed in range(8):
+        s = FaultSchedule.random(seed, max_position=40, n_faults=5)
+        assert not any(f.kind in FLEET_KINDS for f in s.faults)
+        s = FaultSchedule.random_serve(seed, max_position=40)
+        assert not any(f.kind in FLEET_KINDS for f in s.faults)
+
+
+def test_injectors_never_consume_fleet_kinds(tmp_path):
+    """wrap_step/inject_data must pass fleet faults by: their mechanism
+    is FleetScheduler.step, and silently consuming them would erase a
+    scheduled replica-capacity event (the world-kind rule, fleet
+    flavour)."""
+    sched = FaultSchedule([Fault("replica_crash", 0, 0.0),
+                           Fault("migration_torn", 1)])
+    step = sched.wrap_step(_step_fn)
+    state, batch = _init(), jnp.zeros((4,))
+    data = sched.inject_data(_make_data, checkpoint_dir=tmp_path)(0)
+    for _ in range(3):
+        state, _ = step(state, next(data))
+    assert len(sched.fleet_events()) == 2 and not sched.fired

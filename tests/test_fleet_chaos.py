@@ -1,0 +1,370 @@
+"""Fleet under fire (serve/fleet.py, PR 20): the crash-consistency
+pins.  A replica hard-crash (engine object and KV gone, no orderly
+detach) must still finish every stream bitwise — the fleet rebuilds
+residents from its own admission ledger and re-anchors them; a torn
+migration record must be adopted exactly once; the per-replica circuit
+breaker must walk eject -> half-open probe -> recover; a fleet snapshot
+taken mid-storm must restore on a fresh fleet and finish bitwise,
+laddering past corrupt members; and the closed autoscale loop must
+drain-retire and re-add replicas with per-tenant conservation
+(``submitted == done``) intact.  Everything reuses the PR-10 compiled
+geometries — the whole file adds zero new programs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_guide_tpu.models.generation import (
+    make_generate_fn,
+)
+from distributed_tensorflow_guide_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+)
+from distributed_tensorflow_guide_tpu.serve import (
+    FleetScheduler,
+    Request,
+)
+from distributed_tensorflow_guide_tpu.serve import engine as serve_engine
+from distributed_tensorflow_guide_tpu.testing.chaos import (
+    Fault,
+    FaultSchedule,
+    corrupt_checkpoint,
+)
+
+CFG = TransformerConfig(vocab_size=64, num_layers=2, num_heads=2,
+                        d_model=16, d_ff=32, max_len=64, causal=True,
+                        dtype=jnp.float32)
+
+PROMPTS = [np.array([3, 5, 7, 9, 11], np.int32),
+           np.array([2, 4, 6, 8, 10, 12, 14, 16, 18], np.int32),
+           np.array([1] * 17, np.int32)]
+MAX_NEW = [8, 6, 10]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return Transformer(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))["params"]
+
+
+_ORACLE_CACHE: dict = {}
+
+
+def _oracle(params, i, temp, top_k):
+    """The test_fleet.py memoized one-shot oracle (same keys, same
+    seeds): request ``i`` must reproduce bitwise wherever it lands."""
+    p, mn = PROMPTS[i], MAX_NEW[i]
+    key = (i, temp, top_k)
+    if key not in _ORACLE_CACHE:
+        gen = make_generate_fn(CFG, max_new_tokens=mn, temperature=temp,
+                               top_k=top_k)
+        out = gen(params, p[None], jax.random.PRNGKey(100 + i))
+        _ORACLE_CACHE[key] = np.asarray(out)[0, len(p):].tolist()
+    return list(_ORACLE_CACHE[key])
+
+
+def _fleet(params, *, temp=0.0, top_k=None, **kw):
+    kw.setdefault("replicas", 2)
+    kw.setdefault("slots", 2)
+    kw.setdefault("num_blocks", 33)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return FleetScheduler(CFG, params, temperature=temp, top_k=top_k,
+                          **kw)
+
+
+def _submit_all(fl, rid0=0):
+    for i, (p, mn) in enumerate(zip(PROMPTS, MAX_NEW)):
+        fl.submit(Request(rid=rid0 + i, prompt=p, max_new_tokens=mn,
+                          rng=jax.random.PRNGKey(100 + i), tenant=i % 2))
+
+
+def _assert_bitwise(fl, params, temp, top_k, rid0=0):
+    got = fl.completions()
+    for i in range(len(PROMPTS)):
+        exp = _oracle(params, i, temp, top_k)
+        assert got[rid0 + i] == exp, f"req {rid0 + i}"
+
+
+# ---- the tentpole pin: hard-crash + stall + torn, still bitwise ------------
+
+
+@pytest.mark.parametrize("temp,top_k", [(0.0, None), (0.8, 10)],
+                         ids=["greedy", "sampled"])
+def test_fleet_bitwise_under_crash_stall_torn(params, temp, top_k):
+    """A seeded fleet storm — replica 0 hard-crashes at tick 3 with a
+    torn migration armed, replica 1 stalls at tick 6 — and every stream
+    still equals its solo one-shot run exactly.  The crash path is the
+    real thing: the engine object is REPLACED, residents are rebuilt
+    from the fleet's admission ledger alone (prompt + emitted tail),
+    and the replacement compiles nothing (memoized geometry)."""
+    fc = FaultSchedule([Fault("replica_crash", 3, 0.0),
+                        Fault("migration_torn", 3),
+                        Fault("replica_stall", 6, 1.0)])
+    fl = _fleet(params, temp=temp, top_k=top_k, fleet_chaos=fc)
+    eng0 = fl.engines[0]
+    compiled = len(serve_engine._STEP_FNS)
+    _submit_all(fl)
+    fl.run()
+    _assert_bitwise(fl, params, temp, top_k)
+    # the crash actually replaced the engine object, with no new program
+    assert fl.engines[0] is not eng0
+    assert len(serve_engine._STEP_FNS) == compiled
+    h = fl.health()
+    assert h["replica_crashes"] == 1
+    assert h["replica_stalls"] == 1
+    # the torn record rode behind the crash re-anchors and was dropped
+    # exactly once at dispatch
+    assert h["migration_dups_dropped"] == 1
+    # the crashed replica came back through the half-open probe
+    assert h["breaker_probes"] >= 1 and h["breaker_recoveries"] >= 1
+    assert all(r["breaker"]["state"] == "closed" for r in h["replicas"])
+    assert h["stalled"] == [] and h["completed"] == 3
+    # the schedule drained: every fleet fault fired exactly once
+    assert fc.fleet_events() == []
+    fl.check_leaks()
+    fl.close()
+
+
+def test_torn_migration_adopted_exactly_once(params):
+    """Disagg roles with a torn handoff armed: the duplicated migration
+    record carries the SAME handoff id, so dispatch drops it
+    idempotently — three migrations, one dup dropped, zero streams
+    double-admitted, per-tenant conservation intact."""
+    fc = FaultSchedule([Fault("migration_torn", 1)])
+    fl = _fleet(params, roles="disagg", fleet_chaos=fc)
+    _submit_all(fl)
+    fl.run()
+    _assert_bitwise(fl, params, 0.0, None)
+    h = fl.health()
+    assert fl.migrations == 3 and sorted(fl.migrated_rids) == [0, 1, 2]
+    assert h["migration_dups_dropped"] == 1
+    tenants = h["tenants"]
+    assert all(c["submitted"] == c["done"] for c in tenants.values())
+    fl.check_leaks()
+    fl.close()
+
+
+def test_double_residency_crashes_completions(params):
+    """The conservation tripwire: a rid whose emitted tokens appear on
+    two replicas (here: the graveyard AND a live engine) must crash
+    ``completions()`` loudly, not merge silently."""
+    fl = _fleet(params)
+    _submit_all(fl)
+    fl.run()
+    got = fl.completions()
+    fl._grave_completions[0] = list(got[0])  # the double-count
+    with pytest.raises(AssertionError, match="two replicas"):
+        fl.completions()
+    fl.close()
+
+
+# ---- the fleet-door circuit breaker ----------------------------------------
+
+
+def test_breaker_eject_half_open_recover(params):
+    """Two consecutive escaped step exceptions on replica 0 trip its
+    breaker (threshold 2): ejected with streams re-anchored, excluded
+    from routing through the backoff, probed half-open, recovered — and
+    every stream still finishes bitwise.  The engine-level
+    ``launch_failures`` (attempts) and fleet-level ``replica_faults``
+    (escapes) count separately."""
+    chaos0 = FaultSchedule([Fault("serve_step_exception", 1),
+                            Fault("serve_step_exception", 2)])
+    fl = _fleet(params, chaos=[chaos0, None], breaker_threshold=2,
+                breaker_backoff_ticks=2)
+    for eng in fl.engines:
+        eng.retry_attempts = 1  # injected exceptions escape step()
+    _submit_all(fl)
+    fl.run()
+    _assert_bitwise(fl, params, 0.0, None)
+    h = fl.health()
+    assert h["replica_faults"] == 2
+    assert h["breaker_ejections"] == 1
+    assert h["breaker_probes"] >= 1
+    assert h["breaker_recoveries"] == 1
+    assert h["launch_failures"] >= 2  # the engine-side attempt counter
+    assert all(r["breaker"]["state"] == "closed" for r in h["replicas"])
+    fl.check_leaks()
+    fl.close()
+
+
+def test_stall_recovery_rejoins_routing(params):
+    """A stalled replica detaches orderly (KV stays behind — the device
+    is wedged, the host is not), sits out ``stall_recovery_ticks``
+    excluded from routing, and rejoins with its caches warm."""
+    fc = FaultSchedule([Fault("replica_stall", 2, 0.0)])
+    fl = _fleet(params, fleet_chaos=fc, stall_recovery_ticks=2)
+    eng0 = fl.engines[0]
+    _submit_all(fl)
+    fl.run()
+    _assert_bitwise(fl, params, 0.0, None)
+    h = fl.health()
+    assert h["replica_stalls"] == 1 and h["stalled"] == []
+    assert fl.engines[0] is eng0  # stall never replaces the engine
+    kinds = [t["kind"] for t in fl.timeline]
+    assert "replica_stall" in kinds and "replica_recovered" in kinds
+    fl.check_leaks()
+    fl.close()
+
+
+# ---- fleet snapshot / restore ----------------------------------------------
+
+
+def _emit_until(fl, stop_tokens):
+    emitted = 0
+    while emitted < stop_tokens:
+        evs, _ = fl.step(now=float("inf"))
+        emitted += sum(1 for e in evs if e.status == "ok" and e.token >= 0)
+    return emitted
+
+
+def test_snapshot_restore_bitwise_through_crash_and_torn(params, tmp_path):
+    """The acceptance pin: kill the whole fleet at >= 1/3 of its total
+    tokens — AFTER a replica hard-crash and a torn migration have
+    already fired — snapshot, restore on a FRESH fleet (new engines,
+    cold caches), and finish.  Every stream bitwise; the storm counters
+    ride through the snapshot."""
+    total = sum(MAX_NEW)
+    fc = FaultSchedule([Fault("replica_crash", 2, 1.0),
+                        Fault("migration_torn", 2)])
+    fl = _fleet(params, temp=0.8, top_k=10, fleet_chaos=fc,
+                snapshot_dir=tmp_path)
+    _submit_all(fl)
+    _emit_until(fl, total // 3)
+    assert fl.replica_crashes == 1  # the storm fired before the kill
+    label = fl.save_snapshot()
+    assert label is not None
+    fl.close()
+
+    fl2 = _fleet(params, temp=0.8, top_k=10, snapshot_dir=tmp_path)
+    assert fl2.restore_latest_snapshot() == label
+    fl2.run()
+    _assert_bitwise(fl2, params, 0.8, 10)
+    h = fl2.health()
+    assert h["replica_crashes"] == 1  # counters survived the restore
+    assert h["migration_dups_dropped"] == 1
+    tenants = h["tenants"]
+    assert all(c["submitted"] == c["done"] for c in tenants.values())
+    fl2.check_leaks()
+    fl2.close()
+
+
+def test_corrupt_fleet_snapshot_ladders_to_previous(params, tmp_path):
+    """Post-commit corruption of the newest fleet snapshot (truncated
+    payload — the manifest size check catches it): restore ladders to
+    the previous committed member and the run still finishes bitwise."""
+    total = sum(MAX_NEW)
+    fl = _fleet(params, snapshot_dir=tmp_path)
+    _submit_all(fl)
+    _emit_until(fl, total // 3)
+    first = fl.save_snapshot()
+    fl.step(now=float("inf"))
+    fl.step(now=float("inf"))
+    second = fl.save_snapshot()
+    assert second > first
+    fl.close()
+
+    corrupt_checkpoint(tmp_path, mode="truncate")  # newest = `second`
+    fl2 = _fleet(params, snapshot_dir=tmp_path)
+    assert fl2.restore_latest_snapshot() == first
+    fl2.run()
+    _assert_bitwise(fl2, params, 0.0, None)
+    fl2.check_leaks()
+    fl2.close()
+
+
+def test_restore_empty_dir_returns_none(params, tmp_path):
+    fl = _fleet(params, snapshot_dir=tmp_path)
+    assert fl.restore_latest_snapshot() is None
+    fl.close()
+
+
+# ---- the closed autoscale loop ---------------------------------------------
+
+
+def test_autoscale_drain_down_then_add_conserves_streams(params):
+    """Scale-down is a graceful drain (routing stops, queued work
+    re-anchors, residents migrate or finish, only an EMPTY replica
+    retires) and scale-up re-admits the retired replica under queue
+    pressure — across both, zero dropped streams and per-tenant
+    ``submitted == done``."""
+    fl = _fleet(params, apply_autoscale=True, autoscale_every=1,
+                autoscale_params={"hysteresis": 2, "down_pressure": 2.0})
+    _submit_all(fl)
+    fl.run()
+    h = fl.health()
+    assert h["autoscale_retired"] == 1 and h["live_replicas"] == 1
+    _assert_bitwise(fl, params, 0.0, None)
+
+    # phase 2: flip the policy toward pressure and offer a burst — the
+    # retired replica is re-admitted (memoized geometry, compiles
+    # nothing) and the burst drains on the widened fleet
+    compiled = len(serve_engine._STEP_FNS)
+    fl.autoscale_params.update(
+        {"hysteresis": 1, "up_pressure": 0.0, "down_pressure": -1.0})
+    _submit_all(fl, rid0=100)
+    _submit_all(fl, rid0=200)
+    fl.run()
+    h = fl.health()
+    assert h["autoscale_added"] >= 1 and h["live_replicas"] == 2
+    assert len(serve_engine._STEP_FNS) == compiled
+    _assert_bitwise(fl, params, 0.0, None, rid0=100)
+    _assert_bitwise(fl, params, 0.0, None, rid0=200)
+    tenants = h["tenants"]
+    assert all(c["submitted"] == c["done"] for c in tenants.values())
+    assert sum(c["done"] for c in tenants.values()) == 9
+    fl.check_leaks()
+    fl.close()
+
+
+# ---- world > 1: per-replica DP x TP meshes ---------------------------------
+
+
+def test_replica_meshes_dp_tp_routing_parity(params):
+    """Two replicas, each anchored on its OWN dp=2 x tp=2 mesh over the
+    fake CPU devices (conftest pins 8): params shard on the last axis
+    over "model", and every routed stream equals the solo run on the
+    same sharded tree — placement across replica meshes is invisible."""
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the conftest 8-device fake CPU world")
+
+    def anchor(tree, devices):
+        mesh = Mesh(np.array(devices).reshape(2, 2), ("data", "model"))
+
+        def put(x):
+            if x.ndim >= 1 and x.shape[-1] % 2 == 0:
+                spec = P(*([None] * (x.ndim - 1) + ["model"]))
+            else:
+                spec = P()
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        return jax.tree.map(put, tree)
+
+    p0 = anchor(params, devs[:4])
+    p1 = anchor(params, devs[4:8])
+    fl = _fleet([p0, p1])
+    _submit_all(fl)
+    fl.run()
+    got = fl.completions()
+    # oracle on the SAME sharded tree: sharded reductions may not match
+    # the unsharded run bitwise, but replica 0 vs replica 1 must (same
+    # layout, different devices)
+    for i in range(len(PROMPTS)):
+        gen = make_generate_fn(CFG, max_new_tokens=MAX_NEW[i],
+                               temperature=0.0, top_k=None)
+        out = gen(p0, PROMPTS[i][None], jax.random.PRNGKey(100 + i))
+        exp = np.asarray(out)[0, len(PROMPTS[i]):].tolist()
+        assert got[i] == exp, f"req {i}"
+    h = fl.health()
+    assert h["completed"] == 3
+    assert all(r["completed"] >= 1 for r in h["replicas"])
+    fl.check_leaks()
+    fl.close()

@@ -630,3 +630,59 @@ def test_roofline_from_env(monkeypatch):
     assert roof.peak_ici_bytes_s == 1e10
     monkeypatch.delenv("DTG_PEAK_ICI_BPS")
     assert obs_recon.Roofline.from_env().peak_ici_bytes_s is None
+
+# ---- fleet reliability plane (PR 20) ----------------------------------------
+
+
+def test_absorb_fleet_shapes_from_real_health(params):
+    """The ``dtg_fleet_*`` reliability series, shape-tested against a
+    REAL ``FleetScheduler.health()`` driven through a crash + stall
+    storm — not a hand-built dict — so the absorber and the health
+    schema cannot drift apart.  The storm's recovery lifecycle must
+    also land in the flight recorder as ``fleet.*`` events."""
+    from distributed_tensorflow_guide_tpu.serve import FleetScheduler
+    from distributed_tensorflow_guide_tpu.testing.chaos import Fault
+
+    rec = obs_events.FlightRecorder()
+    fc = FaultSchedule([Fault("replica_crash", 3, 0.0),
+                        Fault("migration_torn", 3),
+                        Fault("replica_stall", 6, 1.0)])
+    fl = FleetScheduler(CFG, params, replicas=2, slots=2, num_blocks=33,
+                        block_size=8, prefill_chunk=8, temperature=0.8,
+                        top_k=10, fleet_chaos=fc, recorder=rec)
+    for i, (p, mn) in enumerate(zip(PROMPTS, MAX_NEW)):
+        fl.submit(Request(rid=i, prompt=p, max_new_tokens=mn,
+                          rng=jax.random.PRNGKey(100 + i), tenant=i % 2))
+    fl.run()
+    h = fl.health()
+    kinds = {str(e.kind) for e in rec.events()}
+    assert {"fleet.replica_crash", "fleet.replica_stall",
+            "fleet.migration_torn", "fleet.migrate_dup",
+            "fleet.replica_probe",
+            "fleet.replica_recovered"} <= kinds
+
+    reg = obs_metrics.Registry()
+    obs_metrics.absorb_fleet(reg, h)
+    snap = reg.snapshot()
+    assert snap["dtg_fleet_replica_crashes_total"] == 1
+    assert snap["dtg_fleet_replica_stalls_total"] == 1
+    assert snap["dtg_fleet_migration_dups_dropped_total"] == 1
+    assert snap["dtg_fleet_breaker_probes_total"] >= 1
+    assert snap["dtg_fleet_breaker_recoveries_total"] >= 1
+    assert snap["dtg_fleet_completed_total"] == 3
+    assert snap["dtg_fleet_stalled_replicas"] == 0
+    assert snap["dtg_fleet_draining_replicas"] == 0
+    assert snap["dtg_fleet_autoscale_target"] == 2
+    # per-replica reliability gauges under {replica, role} labels
+    assert snap['dtg_fleet_replica_breaker_open'
+                '{replica="0",role="colocated"}'] == 0.0
+    assert snap['dtg_fleet_replica_breaker_open'
+                '{replica="1",role="colocated"}'] == 0.0
+    assert 'dtg_fleet_replica_launch_failures_total' \
+        '{replica="0",role="colocated"}' in snap
+    # the engine-level attempt counter rolls up separately from the
+    # fleet-level step-boundary fault counter
+    assert "dtg_fleet_launch_failures_total" in snap
+    assert "dtg_fleet_replica_faults_total" in snap
+    fl.check_leaks()
+    fl.close()
